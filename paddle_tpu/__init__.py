@@ -1,0 +1,86 @@
+"""paddle_tpu: a TPU-native deep learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas.
+
+Usage mirrors the reference's python surface::
+
+    import paddle_tpu as paddle
+    paddle.device.set_device("tpu")
+    x = paddle.to_tensor([[1., 2.], [3., 4.]])
+    y = paddle.matmul(x, x)
+    y.sum().backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    bfloat16, float16, float32, float64, int8, int16, int32, int64,
+    uint8, uint16, uint32, uint64, bool_, complex64, complex128,
+    float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype,
+)
+from .core.tensor import Tensor, to_tensor
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad
+from .core.rng import seed, get_rng_state, set_rng_state, Generator
+from .core.flags import get_flags, set_flags, define_flag
+from .core import device
+from .core.device import (
+    set_device, get_device, is_compiled_with_tpu, CPUPlace, TPUPlace, Place,
+)
+
+from .ops import *  # noqa: F401,F403 — the paddle.* op surface
+from .ops.logic import is_tensor
+
+# Subsystem imports (grown as modules land; see _OPTIONAL below).
+import importlib as _importlib
+
+_OPTIONAL = [
+    "nn", "optimizer", "amp", "io", "jit", "static", "vision", "metric",
+    "distributed", "autograd", "framework", "profiler", "incubate", "utils",
+    "hapi", "text", "sparse", "linalg_api",
+]
+for _m in _OPTIONAL:
+    try:
+        globals()[_m] = _importlib.import_module(f".{_m}", __name__)
+    except ImportError:
+        pass
+del _importlib, _m
+
+try:
+    from .framework.io import save, load  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .hapi.model import Model  # noqa: F401
+    from .hapi import callbacks  # noqa: F401
+except ImportError:
+    pass
+
+# paddle.disable_static/enable_static parity: this framework is always
+# "dygraph" at the API level; to_static compiles whole programs via XLA.
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes=dtypes, input=input)

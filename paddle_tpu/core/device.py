@@ -1,0 +1,133 @@
+"""Device / Place abstraction.
+
+Reference parity: ``paddle/phi/common/place.h`` Place classes and the python
+``paddle.device`` module (set_device/get_device).  On TPU there is one device
+kind that matters; CPU is the host/test backend.  A Place wraps a jax.Device.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+
+class Place:
+    """Device identity: a backend kind + ordinal (reference: phi::Place)."""
+
+    __slots__ = ("kind", "index")
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self.index == other.index
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+    def is_tpu_place(self):
+        return self.kind == "tpu"
+
+    def is_cpu_place(self):
+        return self.kind == "cpu"
+
+    @property
+    def jax_device(self) -> jax.Device:
+        return _jax_device_for(self.kind, self.index)
+
+
+class CPUPlace(Place):
+    def __init__(self, index: int = 0):
+        super().__init__("cpu", index)
+
+
+class TPUPlace(Place):
+    def __init__(self, index: int = 0):
+        super().__init__("tpu", index)
+
+
+# Accelerator platform names that map to the "tpu" place kind. "axon" is a
+# tunneled TPU platform seen in some environments.
+_TPU_PLATFORMS = ("tpu", "axon")
+
+_current_place: Optional[Place] = None
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_by_kind(kind: str):
+    if kind == "cpu":
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            return []
+    devs = []
+    for plat in _TPU_PLATFORMS:
+        try:
+            devs = jax.devices(plat)
+        except RuntimeError:
+            continue
+        if devs:
+            break
+    return devs
+
+
+def _jax_device_for(kind: str, index: int) -> jax.Device:
+    devs = _devices_by_kind(kind)
+    if not devs:
+        raise RuntimeError(f"no {kind} devices available")
+    return devs[index % len(devs)]
+
+
+def _default_place() -> Place:
+    d = jax.devices()[0]
+    kind = "tpu" if d.platform in _TPU_PLATFORMS else "cpu"
+    return Place(kind, 0)
+
+
+def set_device(device: str) -> Place:
+    """paddle.device.set_device('tpu') / 'cpu' / 'tpu:0'."""
+    global _current_place
+    if ":" in device:
+        kind, idx = device.split(":")
+        idx = int(idx)
+    else:
+        kind, idx = device, 0
+    kind = kind.lower()
+    if kind in ("gpu", "cuda", "xpu", "npu"):
+        # Accelerator alias: on this framework the accelerator is the TPU.
+        kind = "tpu"
+    if kind not in ("cpu", "tpu"):
+        raise ValueError(f"unsupported device {device!r}")
+    _current_place = Place(kind, idx)
+    return _current_place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.kind}:{p.index}"
+
+
+def current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = _default_place()
+    return _current_place
+
+
+def is_compiled_with_tpu() -> bool:
+    return len(_devices_by_kind("tpu")) > 0
+
+
+def device_count(kind: Optional[str] = None) -> int:
+    if kind is None:
+        kind = current_place().kind
+    return len(_devices_by_kind(kind))

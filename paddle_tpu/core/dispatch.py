@@ -1,0 +1,119 @@
+"""Op dispatch: pure-jax primal + tape recording.
+
+Reference parity: the generated ``*_final_state_dygraph_function`` layer
+(eager_gen.py:858) — forward compute, AMP cast, grad-node construction — and
+the phi kernel dispatch (kernel_factory.h:271).  TPU-native design: every op
+is a pure function on jax arrays; XLA is the kernel library, so there is no
+registry/dispatch-by-place.  ``apply_op`` runs the primal (through jax.vjp if
+any differentiable input requires grad) and records one TapeNode.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from .autograd import TapeNode, is_grad_enabled
+from .tensor import Tensor
+from .flags import get_flag
+
+_CHECK_NAN_OPS_SKIP = {"isnan", "isinf", "isfinite", "nan_to_num"}
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value()
+    return x
+
+
+def _is_diff_dtype(arr) -> bool:
+    try:
+        return dtype_mod.is_floating_point(np.dtype(arr.dtype)) or dtype_mod.is_complex(
+            np.dtype(arr.dtype)
+        )
+    except Exception:
+        return False
+
+
+# AMP autocast hook — installed by paddle_tpu.amp (reference: eager
+# amp_auto_cast.h).  Signature: fn(op_name, tensor_args) -> tensor_args.
+_amp_cast_hook = None
+
+
+def apply_op(
+    name: str,
+    primal: Callable,
+    tensor_args: Sequence[Any],
+    kwargs: dict = None,
+    n_outs: int = 1,
+):
+    """Execute op ``primal(*arrays, **kwargs)`` over Tensor/array args.
+
+    - non-Tensor args are passed through as-is (static attrs go in kwargs)
+    - records a TapeNode via jax.vjp over the *differentiable Tensor* inputs
+    - returns Tensor (or tuple of Tensors if n_outs > 1)
+    """
+    kwargs = kwargs or {}
+    if _amp_cast_hook is not None:
+        tensor_args = _amp_cast_hook(name, tensor_args)
+
+    arrays = [_unwrap(a) for a in tensor_args]
+
+    diff_idx: List[int] = []
+    if is_grad_enabled():
+        for i, a in enumerate(tensor_args):
+            if (
+                isinstance(a, Tensor)
+                and not a.stop_gradient
+                and _is_diff_dtype(arrays[i])
+            ):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out = primal(*arrays, **kwargs)
+        return _wrap_outs(name, out, n_outs, stop_gradient=True)
+
+    def _primal_on_diff(*diff_arrays):
+        full = list(arrays)
+        for j, i in enumerate(diff_idx):
+            full[i] = diff_arrays[j]
+        return primal(*full, **kwargs)
+
+    outs, vjp_fn = jax.vjp(_primal_on_diff, *[arrays[i] for i in diff_idx])
+    out_tensors = _wrap_outs(name, outs, n_outs, stop_gradient=False)
+    outs_list = list(out_tensors) if isinstance(out_tensors, tuple) else [out_tensors]
+    node = TapeNode(
+        vjp_fn,
+        inputs=[tensor_args[i] for i in diff_idx],
+        outputs=outs_list,
+        name=name,
+    )
+    for t in outs_list:
+        t._grad_node = node
+    return out_tensors
+
+
+def _wrap_outs(name, out, n_outs, stop_gradient):
+    if get_flag("check_nan_inf") and name not in _CHECK_NAN_OPS_SKIP:
+        _check_nan_inf(name, out)
+    if n_outs == 1 and not isinstance(out, (tuple, list)):
+        return Tensor._wrap(out, stop_gradient=stop_gradient)
+    outs = tuple(Tensor._wrap(o, stop_gradient=stop_gradient) for o in out)
+    return outs
+
+
+def _check_nan_inf(name, out):
+    """FLAGS_check_nan_inf parity (reference: details/nan_inf_utils_detail.cc)."""
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for o in outs:
+        try:
+            a = np.asarray(o)
+        except Exception:
+            return  # tracer: skip under jit
+        if a.dtype.kind in "fc" and not np.isfinite(a).all():
+            raise FloatingPointError(f"Operator {name} output contains NaN/Inf")
+
+

@@ -1,0 +1,129 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference's common scalar types (``paddle/phi/common/data_type.h``,
+exposed in python as ``paddle.float32`` etc.) but is simply a thin veneer over
+numpy/ml_dtypes dtypes so that every paddle_tpu dtype *is* a jax-compatible
+``np.dtype``.  bfloat16 is first-class (TPU native compute type).
+"""
+from __future__ import annotations
+
+import numpy as np
+import ml_dtypes
+
+# Canonical dtype objects (np.dtype instances; jax accepts these directly).
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float16 = np.dtype(np.float16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+uint8 = np.dtype(np.uint8)
+uint16 = np.dtype(np.uint16)
+uint32 = np.dtype(np.uint32)
+uint64 = np.dtype(np.uint64)
+bool_ = np.dtype(np.bool_)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_NAME_TO_DTYPE = {
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {bfloat16, float16, float32, float64, float8_e4m3fn, float8_e5m2}
+_COMPLEX = {complex64, complex128}
+_INTEGRAL = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+
+_default_dtype = float32
+
+# TPU-native canonicalization: 64-bit types are not XLA-native on TPU and jax
+# runs with x64 disabled, so 64-bit dtypes canonicalize to their 32-bit
+# counterparts (the reference keeps true int64; we document the difference).
+_CANONICAL = {int64: int32, uint64: uint32, float64: float32, complex128: complex64}
+
+import warnings as _warnings
+
+_warnings.filterwarnings(
+    "ignore", message="Explicitly requested dtype.*truncated", category=UserWarning
+)
+
+
+def canonicalize(dtype):
+    d = convert_dtype(dtype)
+    return _CANONICAL.get(d, d)
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, paddle dtype) to
+    np.dtype, canonicalizing 64-bit types to 32-bit (TPU-native; see
+    ``_CANONICAL``)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            d = _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    else:
+        d = np.dtype(dtype)
+    return _CANONICAL.get(d, d)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    if d == bfloat16:
+        return "bfloat16"
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGRAL
+
+
+def set_default_dtype(dtype):
+    """Set the default floating dtype (reference: paddle.set_default_dtype)."""
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if d not in _FLOATING:
+        raise TypeError(f"default dtype must be floating, got {dtype}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype

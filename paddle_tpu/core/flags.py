@@ -1,0 +1,78 @@
+"""Typed runtime flag registry.
+
+Reference parity: the ~56 ``PADDLE_DEFINE_EXPORTED`` gflags in
+``paddle/fluid/platform/flags.cc`` plus python ``paddle.get_flags/set_flags``
+(``python/paddle/fluid/framework.py:7112``).  Here: a single typed registry,
+env-seeded (``FLAGS_*``), readable and writable from python.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any = None
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_registry: Dict[str, _Flag] = {}
+_lock = threading.Lock()
+
+
+def _parse(tp: type, raw: str):
+    if tp is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return tp(raw)
+
+
+def define_flag(name: str, default, help: str = "", on_change=None):
+    """Register a flag; env var FLAGS_<name> overrides the default."""
+    tp = type(default)
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _parse(tp, env) if env is not None else default
+    with _lock:
+        _registry[name] = _Flag(name, default, tp, help, value, on_change)
+    return value
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    with _lock:
+        if names is None:
+            return {k: f.value for k, f in _registry.items()}
+        if isinstance(names, str):
+            names = [names]
+        return {n: _registry[n].value for n in names}
+
+
+def get_flag(name: str):
+    return _registry[name].value
+
+
+def set_flags(flags: Dict[str, Any]):
+    with _lock:
+        for name, val in flags.items():
+            if name not in _registry:
+                raise KeyError(f"unknown flag {name!r}")
+            f = _registry[name]
+            f.value = _parse(f.type, val) if isinstance(val, str) else f.type(val)
+            if f.on_change:
+                f.on_change(f.value)
+
+
+# Core flags (subset of reference's platform/flags.cc relevant on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf each eager op")
+define_flag("benchmark", False, "sync after each op for timing")
+define_flag("low_precision_op_list", False, "log ops run under AMP autocast")
+define_flag("use_flash_attention", True, "use Pallas flash-attention kernels")
+define_flag("allocator_strategy", "xla", "memory allocator strategy (XLA-managed)")
+define_flag("tracer_mkldnn_ops_on", "", "unused; API parity only")
+define_flag("cache_jit_programs", True, "cache compiled to_static programs")
+define_flag("eager_op_jit", True, "jit-compile eager per-op dispatch")

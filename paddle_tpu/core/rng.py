@@ -1,0 +1,72 @@
+"""Stateful RNG over jax PRNG keys.
+
+Reference parity: ``phi::Generator`` (phi/core/generator.h) + ``paddle.seed``.
+Design: the generator state is a uint32 key held in a **Tensor**, so that under
+to_static tracing the state is lifted into a program input/output — random ops
+stay functional inside the compiled program while the python API stays
+stateful (the same trick the reference plays with generator state vars in
+ProgramDesc).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._state = Tensor._wrap(jax.random.key_data(jax.random.PRNGKey(seed)))
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._state._set_data(jax.random.key_data(jax.random.PRNGKey(seed)))
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self) -> Tensor:
+        return Tensor._wrap(self._state._value())
+
+    def set_state(self, state: Tensor):
+        self._state._set_data(state._value())
+
+    def split_key(self):
+        """Advance state; return a fresh key array for one random op."""
+        key = jax.random.wrap_key_data(self._state._value())
+        next_key, sub = jax.random.split(key)
+        self._state._set_data(jax.random.key_data(next_key))
+        return sub
+
+
+_default_generator: Optional[Generator] = None
+
+
+def default_generator() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(0)
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed — reseed the global generator."""
+    return default_generator().manual_seed(int(s))
+
+
+def next_key():
+    return default_generator().split_key()
+
+
+def get_rng_state():
+    return default_generator().get_state()
+
+
+def set_rng_state(state):
+    default_generator().set_state(state)

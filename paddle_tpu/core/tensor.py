@@ -1,0 +1,385 @@
+"""The paddle_tpu Tensor: an imperative façade over jax.Array.
+
+Reference parity: ``phi::DenseTensor`` (dense_tensor.h:37) + the eager
+``paddle::experimental::Tensor`` python object (pybind eager_method.cc).
+TPU-native design: the payload is an immutable ``jax.Array`` (or jax tracer,
+under to_static capture); imperative semantics (in-place ops, ``.grad``,
+version counter) live in this thin python shell.  All compute goes through
+``paddle_tpu.core.dispatch`` which records the autograd tape.
+
+Every read of the payload goes through ``_value()`` and every write through
+``_set_data()`` so that the to_static tracer (jit/trace.py) can lift
+externally-created tensors (parameters, optimizer state, RNG state) into
+arguments/results of the compiled program — the trace-based equivalent of the
+reference's dy2static variable scoping (run_program_op.cc:221).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from .device import Place, current_place
+from . import autograd
+
+# Set by paddle_tpu.jit.trace while a to_static capture is active.
+_trace_hook = None
+
+
+def _active_hook():
+    return _trace_hook
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "_grad",
+        "_grad_node",
+        "stop_gradient",
+        "name",
+        "persistable",
+        "trainable",
+        "_version",
+        "_backward_hooks",
+        "__weakref__",
+    )
+
+    # -- construction -----------------------------------------------------
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True, name=None):
+        if data is None:
+            arr = None
+        else:
+            arr = _to_jax_array(data, dtype, place)
+        self._data = arr
+        self._grad = None
+        self._grad_node = None
+        self.stop_gradient = stop_gradient
+        self.name = name or ""
+        self.persistable = False
+        self.trainable = True
+        self._version = 0
+        self._backward_hooks = None
+
+    @staticmethod
+    def _wrap(arr, stop_gradient=True, name=None) -> "Tensor":
+        t = Tensor.__new__(Tensor)
+        t._data = arr
+        t._grad = None
+        t._grad_node = None
+        t.stop_gradient = stop_gradient
+        t.name = name or ""
+        t.persistable = False
+        t.trainable = True
+        t._version = 0
+        t._backward_hooks = None
+        return t
+
+    # -- payload access (trace-aware) -------------------------------------
+
+    def _value(self):
+        """The jax array for compute.  Trace hook may lift external tensors."""
+        h = _trace_hook
+        if h is not None:
+            return h.read(self)
+        return self._data
+
+    def _set_data(self, arr):
+        """In-place payload replacement (all in-place ops funnel here)."""
+        h = _trace_hook
+        if h is not None:
+            h.write(self, arr)
+        else:
+            self._data = arr
+        self._version += 1
+
+    def _accumulate_grad(self, g):
+        if self._backward_hooks:
+            for fn in self._backward_hooks.values():
+                out = fn(Tensor._wrap(g, stop_gradient=True))
+                if out is not None:
+                    g = out._value() if isinstance(out, Tensor) else jnp.asarray(out)
+        h = _trace_hook
+        cur = h.read_grad(self) if h is not None else self._grad
+        new = g if cur is None else cur + g
+        if h is not None:
+            h.write_grad(self, new)
+        else:
+            self._grad = new
+
+    # -- metadata ---------------------------------------------------------
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value().shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value().ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value().dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value().shape)) if self._value().shape else 1
+
+    @property
+    def place(self) -> Place:
+        d = self._data
+        if isinstance(d, jax.Array) and hasattr(d, "devices") and not _is_tracer(d):
+            try:
+                dev = next(iter(d.devices()))
+                kind = "tpu" if dev.platform in ("tpu", "axon") else "cpu"
+                return Place(kind, dev.id)
+            except Exception:
+                pass
+        return current_place()
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        h = _trace_hook
+        g = h.read_grad(self) if h is not None else self._grad
+        if g is None:
+            return None
+        return Tensor._wrap(g, stop_gradient=True, name=self.name + "@GRAD")
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._clear_grad()
+        else:
+            g = value._value() if isinstance(value, Tensor) else jnp.asarray(value)
+            h = _trace_hook
+            if h is not None:
+                h.write_grad(self, g)
+            else:
+                self._grad = g
+
+    def _clear_grad(self):
+        h = _trace_hook
+        if h is not None:
+            h.write_grad(self, None)
+        else:
+            self._grad = None
+
+    def clear_grad(self):
+        self._clear_grad()
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero:
+            g = self.grad
+            if g is not None:
+                zero = jnp.zeros_like(g._value())
+                h = _trace_hook
+                if h is not None:
+                    h.write_grad(self, zero)
+                else:
+                    self._grad = zero
+        else:
+            self._clear_grad()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def inplace_version(self) -> int:
+        return self._version
+
+    # -- conversion -------------------------------------------------------
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value())
+
+    def item(self, *args):
+        v = self._value()
+        if args:
+            return np.asarray(v).item(*args)
+        return np.asarray(v).item()
+
+    def tolist(self):
+        return np.asarray(self._value()).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self._value())
+
+    def __len__(self):
+        s = self._value().shape
+        if not s:
+            raise TypeError("len() of a 0-d tensor")
+        return s[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd ---------------------------------------------------------
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def detach(self) -> "Tensor":
+        return Tensor._wrap(self._value(), stop_gradient=True, name=self.name)
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    _hook_counter = 0
+
+    def register_hook(self, hook):
+        """Register a grad hook (reference: egr RegisterGradientHook)."""
+        if self._backward_hooks is None:
+            self._backward_hooks = {}
+        Tensor._hook_counter += 1
+        key = Tensor._hook_counter
+        self._backward_hooks[key] = hook
+        tensor = self
+
+        class _Handle:
+            def remove(self):
+                tensor._backward_hooks.pop(key, None)
+
+        return _Handle()
+
+    def _rebind_from(self, out: "Tensor"):
+        """Adopt ``out``'s payload and autograd position (in-place op result).
+        The producing TapeNode's output entry is retargeted to ``self`` so the
+        backward sweep finds cotangents under this tensor's identity."""
+        self._set_data(out._value())
+        node = out._grad_node
+        self._grad_node = node
+        if node is not None:
+            node.outputs = [self if o is out else o for o in node.outputs]
+        if not out.stop_gradient:
+            self.stop_gradient = False
+        return self
+
+    # -- in-place / value ops ---------------------------------------------
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            arr = value._value()
+        else:
+            arr = _to_jax_array(value, self.dtype, None)
+        arr = jnp.asarray(arr, dtype=self._value().dtype)
+        if tuple(arr.shape) != tuple(self._value().shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._value().shape}"
+            )
+        self._set_data(arr)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._set_data(jnp.full_like(self._value(), value))
+        return self
+
+    def zero_(self):
+        self._set_data(jnp.zeros_like(self._value()))
+        return self
+
+    # -- misc -------------------------------------------------------------
+
+    def clone(self) -> "Tensor":
+        from . import dispatch
+
+        return dispatch.apply_op("clone", lambda x: x + 0, [self])
+
+    def to(self, *args, **kwargs):
+        # to(dtype) / to(device) / to(device, dtype)
+        dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
+        for a in args:
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu"):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from .device import set_device, current_place
+
+            kind = device.split(":")[0]
+            kind = "tpu" if kind in ("gpu", "tpu") else "cpu"
+            arr = jax.device_put(out._value(), Place(kind, 0).jax_device)
+            out = Tensor._wrap(arr, stop_gradient=out.stop_gradient)
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self.to("tpu")
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        d = self._value()
+        if _is_tracer(d):
+            body = f"<traced {d.aval}>"
+        else:
+            body = np.array2string(np.asarray(d), precision=6, separator=", ")
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+            f"place={self.place}, stop_gradient={sg},\n       {body})"
+        )
+
+    # astype / math dunders etc. are attached by paddle_tpu.ops at import
+    # time via register_tensor_method().
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_jax_array(data, dtype=None, place=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    if isinstance(data, Tensor):
+        arr = data._value()
+        return jnp.asarray(arr, dtype=dt) if dt is not None else arr
+    if isinstance(data, (jax.Array,)) or _is_tracer(data):
+        return jnp.asarray(data, dtype=dt) if dt is not None else data
+    a = np.asarray(data)
+    if dt is None and a.dtype == np.float64:
+        dt = dtype_mod.get_default_dtype()
+    dev = None
+    if place is not None:
+        dev = place.jax_device if isinstance(place, Place) else None
+    arr = jnp.asarray(a, dtype=dt)
+    if dev is not None:
+        arr = jax.device_put(arr, dev)
+    return arr
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(place, str):
+        kind = place.split(":")[0]
+        place = Place("tpu" if kind in ("gpu", "tpu") else "cpu", 0)
+    arr = _to_jax_array(data, dtype, place)
+    return Tensor._wrap(arr, stop_gradient=stop_gradient)
+
+
+def register_tensor_method(name, fn):
+    """Attach an op as a Tensor method (used by paddle_tpu.ops)."""
+    setattr(Tensor, name, fn)

@@ -1,0 +1,56 @@
+"""Shared helpers for op definitions."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+
+
+def unwrap(x):
+    return x._value() if isinstance(x, Tensor) else x
+
+
+def wrap(arr, stop_gradient=True):
+    return Tensor._wrap(arr, stop_gradient=stop_gradient)
+
+
+def op(name, primal, tensor_args, kwargs=None, n_outs=1):
+    return apply_op(name, primal, tensor_args, kwargs, n_outs=n_outs)
+
+
+def nondiff(name, primal, args, kwargs=None, n_outs=1):
+    """Run an op with no tape recording (integer/bool outputs etc.)."""
+    arrays = [unwrap(a) for a in args]
+    out = primal(*arrays, **(kwargs or {}))
+    if n_outs == 1 and not isinstance(out, (tuple, list)):
+        return wrap(out)
+    return tuple(wrap(o) for o in out)
+
+
+def paddle_reshape_shape(orig_shape, shape):
+    """Paddle reshape semantics: 0 keeps the original dim, -1 infers."""
+    out = []
+    for i, s in enumerate(shape):
+        s = int(s)
+        if s == 0:
+            out.append(orig_shape[i])
+        else:
+            out.append(s)
+    return out
+
+
+def as_int_list(v):
+    if isinstance(v, Tensor):
+        return [int(x) for x in np.asarray(v._value()).reshape(-1)]
+    if isinstance(v, (list, tuple)):
+        res = []
+        for x in v:
+            if isinstance(x, Tensor):
+                res.append(int(x.item()))
+            else:
+                res.append(int(x))
+        return res
+    return [int(v)]

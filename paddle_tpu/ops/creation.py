@@ -1,0 +1,144 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, to_tensor  # re-export to_tensor
+from ._helpers import unwrap, wrap, op, nondiff, as_int_list
+
+
+def _dt(dtype):
+    return dtype_mod.convert_dtype(dtype) if dtype is not None else dtype_mod.get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(as_int_list(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(as_int_list(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    return wrap(jnp.full(as_int_list(shape), fv, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return wrap(jnp.zeros_like(unwrap(x), dtype=d))
+
+
+def ones_like(x, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return wrap(jnp.ones_like(unwrap(x), dtype=d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return wrap(jnp.full_like(unwrap(x), unwrap(fill_value), dtype=d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = dtype_mod.int64
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return wrap(jnp.arange(start, end, step, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return wrap(
+        jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=_dt(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap(
+        jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=base, dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def primal(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return op("diag", primal, [x])
+
+
+def diagflat(x, offset=0, name=None):
+    return op("diagflat", lambda a: jnp.diagflat(a, k=offset), [x])
+
+
+def tril(x, diagonal=0, name=None):
+    return op("tril", lambda a: jnp.tril(a, k=diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None):
+    return op("triu", lambda a: jnp.triu(a, k=diagonal), [x])
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    return op(
+        "meshgrid",
+        lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+        list(tensors),
+        n_outs=len(tensors),
+    )
+
+
+def assign(x, output=None):
+    arr = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output._set_data(jnp.asarray(arr, dtype=output._value().dtype))
+        return output
+    return op("assign", lambda a: a + 0, [x]) if isinstance(x, Tensor) else wrap(arr)
+
+
+def clone(x, name=None):
+    return op("clone", lambda a: a + 0, [x])
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return op("complex", lambda r, i: jax.lax.complex(r, i), [real, imag])
+
+
+import jax  # noqa: E402  (used by complex)

@@ -1,0 +1,233 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; matmul at :222)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import unwrap, wrap, op, nondiff
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """paddle.matmul → MXU.  bf16 inputs stay bf16 (accumulate f32 via XLA)."""
+
+    def primal(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -2, -1) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -2, -1) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return op("matmul", primal, [x, y])
+
+
+def bmm(x, y, name=None):
+    return op("bmm", jnp.matmul, [x, y])
+
+
+def mm(x, y, name=None):
+    return op("mm", jnp.matmul, [x, y])
+
+
+def mv(x, vec, name=None):
+    return op("mv", jnp.matmul, [x, vec])
+
+
+def dot(x, y, name=None):
+    return op("dot", lambda a, b: jnp.sum(a * b, axis=-1), [x, y])
+
+
+def einsum(equation, *operands):
+    return op("einsum", lambda *xs: jnp.einsum(equation, *xs), list(operands))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def primal(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p in ("fro", 2):
+                return jnp.sqrt(jnp.sum(flat * flat)) if not keepdim else jnp.sqrt(
+                    jnp.sum(flat * flat)
+                ).reshape([1] * a.ndim)
+            if p == np.inf or p == "inf":
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return op("norm", primal, [x])
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else wrap(unwrap(x) - unwrap(y)), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    def primal(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return op("cross", primal, [x, y])
+
+
+def cholesky(x, upper=False, name=None):
+    def primal(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -2, -1).conj() if upper else L
+
+    return op("cholesky", primal, [x])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def primal(b, L):
+        Lm = jnp.swapaxes(L, -2, -1).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lm, -2, -1).conj(), z, lower=False
+        )
+
+    return op("cholesky_solve", primal, [x, y])
+
+
+def inv(x, name=None):
+    return op("inverse", jnp.linalg.inv, [x])
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return op("det", jnp.linalg.det, [x])
+
+
+def slogdet(x, name=None):
+    def primal(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return op("slogdet", primal, [x])
+
+
+def qr(x, mode="reduced", name=None):
+    return op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [x], n_outs=2)
+
+
+def svd(x, full_matrices=False, name=None):
+    return op(
+        "svd",
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        [x],
+        n_outs=3,
+    )
+
+
+def eig(x, name=None):
+    return nondiff("eig", lambda a: tuple(jnp.linalg.eig(a)), [x], n_outs=2)
+
+
+def eigh(x, UPLO="L", name=None):
+    return op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), [x], n_outs=2)
+
+
+def eigvals(x, name=None):
+    return nondiff("eigvals", jnp.linalg.eigvals, [x])
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), [x])
+
+
+def matrix_power(x, n, name=None):
+    return op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), [x])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return nondiff(
+        "matrix_rank", lambda a: jnp.linalg.matrix_rank(a, tol=tol), [x]
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond), [x])
+
+
+def solve(x, y, name=None):
+    return op("solve", jnp.linalg.solve, [x, y])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def primal(a, b):
+        aa = jnp.swapaxes(a, -2, -1) if transpose else a
+        return jax.scipy.linalg.solve_triangular(
+            aa, b, lower=not upper, unit_diagonal=unitriangular
+        )
+
+    return op("triangular_solve", primal, [x, y])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def primal(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol
+
+    return op("lstsq", primal, [x, y])
+
+
+def multi_dot(x, name=None):
+    tensors = list(x)
+    return op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), tensors)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    a = unwrap(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(a)
+    outs = [wrap(lu_), wrap(piv.astype(np.int32) + 1)]
+    if get_infos:
+        outs.append(wrap(jnp.zeros((), dtype=np.int32)))
+    return tuple(outs)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = unwrap(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (float(jnp.min(a)), float(jnp.max(a)))
+    hist, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+    return wrap(hist.astype(np.int32))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = unwrap(weights) if weights is not None else None
+    a = np.asarray(unwrap(x))
+    return wrap(jnp.asarray(np.bincount(a, w, minlength)))
+
+
+def matrix_transpose(x, name=None):
+    return op("matrix_transpose", lambda a: jnp.swapaxes(a, -2, -1), [x])
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [x])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return op(
+        "cov",
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
+        [x],
+    )

@@ -1,0 +1,80 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._helpers import nondiff
+
+
+def equal(x, y, name=None):
+    return nondiff("equal", jnp.equal, [x, y])
+
+
+def not_equal(x, y, name=None):
+    return nondiff("not_equal", jnp.not_equal, [x, y])
+
+
+def greater_than(x, y, name=None):
+    return nondiff("greater_than", jnp.greater, [x, y])
+
+
+def greater_equal(x, y, name=None):
+    return nondiff("greater_equal", jnp.greater_equal, [x, y])
+
+
+def less_than(x, y, name=None):
+    return nondiff("less_than", jnp.less, [x, y])
+
+
+def less_equal(x, y, name=None):
+    return nondiff("less_equal", jnp.less_equal, [x, y])
+
+
+def logical_and(x, y, out=None, name=None):
+    return nondiff("logical_and", jnp.logical_and, [x, y])
+
+
+def logical_or(x, y, out=None, name=None):
+    return nondiff("logical_or", jnp.logical_or, [x, y])
+
+
+def logical_xor(x, y, out=None, name=None):
+    return nondiff("logical_xor", jnp.logical_xor, [x, y])
+
+
+def logical_not(x, out=None, name=None):
+    return nondiff("logical_not", jnp.logical_not, [x])
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return nondiff("bitwise_and", jnp.bitwise_and, [x, y])
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return nondiff("bitwise_or", jnp.bitwise_or, [x, y])
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return nondiff("bitwise_xor", jnp.bitwise_xor, [x, y])
+
+
+def bitwise_not(x, out=None, name=None):
+    return nondiff("bitwise_not", jnp.bitwise_not, [x])
+
+
+def bitwise_left_shift(x, y, name=None):
+    return nondiff("bitwise_left_shift", jnp.left_shift, [x, y])
+
+
+def bitwise_right_shift(x, y, name=None):
+    return nondiff("bitwise_right_shift", jnp.right_shift, [x, y])
+
+
+def is_empty(x, name=None):
+    return nondiff("is_empty", lambda a: jnp.asarray(a.size == 0), [x])
+
+
+def is_tensor(x):
+    from ..core.tensor import Tensor
+
+    return isinstance(x, Tensor)

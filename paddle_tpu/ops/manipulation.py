@@ -1,0 +1,467 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ._helpers import unwrap, wrap, op, nondiff, paddle_reshape_shape, as_int_list
+
+
+def cast(x, dtype):
+    dt = dtype_mod.convert_dtype(dtype)
+    if not (dtype_mod.is_floating_point(dt) or dtype_mod.is_complex(dt)):
+        return nondiff("cast", lambda a: a.astype(dt), [x])
+    return op("cast", lambda a: a.astype(dt), [x])
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+def reshape(x, shape, name=None):
+    shape = as_int_list(shape)
+    tgt = paddle_reshape_shape(x.shape, shape)
+    return op("reshape", lambda a: jnp.reshape(a, tgt), [x])
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    _rebind(x, out)
+    return x
+
+
+def _rebind(x: Tensor, out: Tensor):
+    """Make in-place variants keep the autograd graph (x becomes out)."""
+    x._rebind_from(out)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if start_axis < 0 else start_axis
+    e = stop_axis % nd if stop_axis < 0 else stop_axis
+    shape = x.shape
+    new_shape = shape[:s] + [int(np.prod(shape[s : e + 1])) if e >= s else 1] + shape[e + 1 :]
+    return op("flatten", lambda a: jnp.reshape(a, new_shape), [x])
+
+
+def transpose(x, perm, name=None):
+    perm = as_int_list(perm)
+    return op("transpose", lambda a: jnp.transpose(a, perm), [x])
+
+
+def t(x, name=None):
+    if x.ndim <= 1:
+        return clone_like(x)
+    return op("t", lambda a: jnp.swapaxes(a, -2, -1), [x])
+
+
+def clone_like(x):
+    return op("clone", lambda a: a + 0, [x])
+
+
+def moveaxis(x, source, destination, name=None):
+    return op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), [x])
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), [x])
+
+
+def squeeze(x, axis=None, name=None):
+    def primal(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a_ % a.ndim if a_ < 0 else a_ for a_ in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return op("squeeze", primal, [x])
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = as_int_list(axes)
+
+    def primal(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return op("unsqueeze", primal, [x])
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    _rebind(x, out)
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    _rebind(x, out)
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return op("concat", lambda *xs: jnp.concatenate(xs, axis=axis), tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return op("stack", lambda *xs: jnp.stack(xs, axis=axis), tensors)
+
+
+def vstack(x, name=None):
+    return op("vstack", lambda *xs: jnp.vstack(xs), list(x))
+
+
+def hstack(x, name=None):
+    return op("hstack", lambda *xs: jnp.hstack(xs), list(x))
+
+
+def dstack(x, name=None):
+    return op("dstack", lambda *xs: jnp.dstack(xs), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(
+                f"split: axis dim {dim} is not divisible by num {n}"
+            )
+        sizes = [dim // n] * n
+    else:
+        sizes = as_int_list(num_or_sections)
+        if -1 in sizes:
+            known = sum(s for s in sizes if s != -1)
+            sizes = [dim - known if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    n_outs = len(sizes)
+
+    def primal(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, off, off + sz, axis=axis)
+            for off, sz in zip(offsets, sizes)
+        )
+
+    return list(op("split", primal, [x], n_outs=n_outs))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+def tile(x, repeat_times, name=None):
+    reps = as_int_list(repeat_times)
+    return op("tile", lambda a: jnp.tile(a, reps), [x])
+
+
+def expand(x, shape, name=None):
+    tgt = as_int_list(shape)
+    src = x.shape
+    # paddle: -1 means keep the original dim
+    full = []
+    off = len(tgt) - len(src)
+    for i, s in enumerate(tgt):
+        if s == -1:
+            full.append(src[i - off] if i >= off else 1)
+        else:
+            full.append(s)
+    return op("expand", lambda a: jnp.broadcast_to(a, full), [x])
+
+
+def expand_as(x, y, name=None):
+    tgt = y.shape
+    return op("expand_as", lambda a: jnp.broadcast_to(a, tgt), [x])
+
+
+def broadcast_to(x, shape, name=None):
+    return op("broadcast_to", lambda a: jnp.broadcast_to(a, as_int_list(shape)), [x])
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = list(inputs)
+    return list(
+        op(
+            "broadcast_tensors",
+            lambda *xs: tuple(jnp.broadcast_arrays(*xs)),
+            tensors,
+            n_outs=len(tensors),
+        )
+    )
+
+
+def flip(x, axis, name=None):
+    axes = as_int_list(axis if isinstance(axis, (list, tuple)) else [axis])
+    return op("flip", lambda a: jnp.flip(a, axis=axes), [x])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [x])
+
+
+def roll(x, shifts, axis=None, name=None):
+    return op("roll", lambda a: jnp.roll(a, shifts, axis=axis), [x])
+
+
+# ---- gather/scatter family ---------------------------------------------
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    idx = unwrap(index)
+
+    def primal(a):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+
+    return op("gather", primal, [x])
+
+
+def gather_nd(x, index, name=None):
+    idx = unwrap(index)
+
+    def primal(a):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ind]
+
+    return op("gather_nd", primal, [x])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = unwrap(indices)
+    return op(
+        "take_along_axis", lambda a: jnp.take_along_axis(a, idx, axis=axis), [arr]
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = unwrap(indices)
+
+    def primal(a, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), idx.shape)
+        if reduce == "assign":
+            return _scatter_along_axis(a, idx, v, axis, "set")
+        elif reduce in ("add", "sum"):
+            return _scatter_along_axis(a, idx, v, axis, "add")
+        elif reduce in ("mul", "multiply"):
+            return _scatter_along_axis(a, idx, v, axis, "mul")
+        raise ValueError(reduce)
+
+    return op("put_along_axis", primal, [arr, values])
+
+
+def _scatter_along_axis(a, idx, v, axis, mode):
+    # Build full index grids for scatter.
+    axis = axis % a.ndim
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    index_tuple = tuple(idx if d == axis else g for d, g in enumerate(grids))
+    at = a.at[index_tuple]
+    return {"set": at.set, "add": at.add, "mul": at.multiply}[mode](v)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = unwrap(index)
+
+    def primal(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        # paddle: non-overwrite zeroes target rows then accumulates
+        zeroed = a.at[idx].set(jnp.zeros_like(u))
+        return zeroed.at[idx].add(u)
+
+    return op("scatter", primal, [x, updates])
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    _rebind(x, out)
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = unwrap(index)
+
+    def primal(a, u):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ind].add(u)
+
+    return op("scatter_nd_add", primal, [x, updates])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = unwrap(index)
+    return op("index_select", lambda a: jnp.take(a, idx, axis=axis), [x])
+
+
+def index_sample(x, index, name=None):
+    idx = unwrap(index)
+    return op(
+        "index_sample", lambda a: jnp.take_along_axis(a, idx, axis=1), [x]
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = unwrap(index)
+
+    def primal(a, v):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].add(v)
+
+    return op("index_add", primal, [x, value])
+
+
+def masked_select(x, mask, name=None):
+    m = np.asarray(unwrap(mask))
+    return op("masked_select", lambda a: a[jnp.asarray(m)], [x])
+
+
+def masked_fill(x, mask, value, name=None):
+    m = unwrap(mask)
+    return op(
+        "masked_fill",
+        lambda a, v: jnp.where(m, jnp.asarray(v, a.dtype), a),
+        [x, value],
+    )
+
+
+# ---- pads, uniques, etc. ------------------------------------------------
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def unique(
+    x,
+    return_index=False,
+    return_inverse=False,
+    return_counts=False,
+    axis=None,
+    dtype="int64",
+    name=None,
+):
+    a = np.asarray(unwrap(x))
+    res = np.unique(
+        a, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not (return_index or return_inverse or return_counts):
+        return wrap(jnp.asarray(res))
+    outs = [wrap(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+    else:
+        diff = np.any(
+            np.diff(a, axis=axis) != 0,
+            axis=tuple(i for i in range(a.ndim) if i != axis),
+        )
+        keep = np.concatenate([[True], diff])
+    vals = a[keep] if axis is None else np.compress(keep, a, axis=axis)
+    outs = [wrap(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(wrap(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(keep)))
+        outs.append(wrap(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = unwrap(repeats)
+    return op(
+        "repeat_interleave",
+        lambda a: jnp.repeat(a, r, axis=axis),
+        [x],
+    )
+
+
+def as_real(x, name=None):
+    return op(
+        "as_real",
+        lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+        [x],
+    )
+
+
+def as_complex(x, name=None):
+    return op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [x])
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(x.size, dtype=np.int32))
+
+
+def shape(x):
+    return wrap(jnp.asarray(np.array(x.shape, dtype=np.int32)))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def primal(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_range = (a >= lo) & (a < hi)
+        return jnp.where(in_range, a - lo, ignore_value)
+
+    return nondiff("shard_index", primal, [input])
+
+
+def one_hot(x, num_classes, name=None):
+    return nondiff(
+        "one_hot",
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=dtype_mod.get_default_dtype()),
+        [x],
+    )
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = int(ax.item())
+    return op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), [x, y])
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    tgt = as_int_list(shape)
+    offs = as_int_list(offsets) if offsets is not None else [0] * len(tgt)
+    tgt = [t if t != -1 else x.shape[i] - offs[i] for i, t in enumerate(tgt)]
+
+    def primal(a):
+        return jax.lax.dynamic_slice(a, offs, tgt)
+
+    return op("crop", primal, [x])

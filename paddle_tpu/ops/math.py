@@ -1,0 +1,410 @@
+"""Elementwise & reduction math ops (reference: python/paddle/tensor/math.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ._helpers import unwrap, wrap, op, nondiff
+
+
+# ---- binary elementwise -------------------------------------------------
+
+def add(x, y, name=None):
+    return op("add", jnp.add, [x, y])
+
+
+def subtract(x, y, name=None):
+    return op("subtract", jnp.subtract, [x, y])
+
+
+def multiply(x, y, name=None):
+    return op("multiply", jnp.multiply, [x, y])
+
+
+def divide(x, y, name=None):
+    return op("divide", jnp.divide, [x, y])
+
+
+def floor_divide(x, y, name=None):
+    return nondiff("floor_divide", jnp.floor_divide, [x, y])
+
+
+def remainder(x, y, name=None):
+    return op("remainder", jnp.remainder, [x, y])
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return op("pow", jnp.power, [x, y])
+
+
+def maximum(x, y, name=None):
+    return op("maximum", jnp.maximum, [x, y])
+
+
+def minimum(x, y, name=None):
+    return op("minimum", jnp.minimum, [x, y])
+
+
+def fmax(x, y, name=None):
+    return op("fmax", jnp.fmax, [x, y])
+
+
+def fmin(x, y, name=None):
+    return op("fmin", jnp.fmin, [x, y])
+
+
+def atan2(x, y, name=None):
+    return op("atan2", jnp.arctan2, [x, y])
+
+
+def logaddexp(x, y, name=None):
+    return op("logaddexp", jnp.logaddexp, [x, y])
+
+
+def heaviside(x, y, name=None):
+    return op("heaviside", jnp.heaviside, [x, y])
+
+
+def hypot(x, y, name=None):
+    return op("hypot", jnp.hypot, [x, y])
+
+
+def lerp(x, y, weight, name=None):
+    return op("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+
+
+def nextafter(x, y, name=None):
+    return nondiff("nextafter", jnp.nextafter, [x, y])
+
+
+def gcd(x, y, name=None):
+    return nondiff("gcd", jnp.gcd, [x, y])
+
+
+def lcm(x, y, name=None):
+    return nondiff("lcm", jnp.lcm, [x, y])
+
+
+# ---- unary elementwise --------------------------------------------------
+
+def _unary(op_name, fn):
+    def f(x, name=None):
+        return op(op_name, fn, [x])
+
+    f.__name__ = op_name
+    return f
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+reciprocal = _unary("reciprocal", lambda a: 1.0 / a)
+neg = _unary("neg", jnp.negative)
+negative = neg
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+i1 = _unary("i1", jax.scipy.special.i1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def primal(a, s, b):
+        if bias_after_scale:
+            return a * s + b
+        return (a + b) * s
+
+    out = op("scale", primal, [x, scale, bias])
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    out = op("increment", lambda a: a + jnp.asarray(value, a.dtype), [x])
+    x._set_data(out._value())
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = unwrap(min) if min is not None else None
+    mx = unwrap(max) if max is not None else None
+    return op("clip", lambda a: jnp.clip(a, mn, mx), [x])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return op(
+        "nan_to_num",
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        [x],
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), [x])
+
+
+def rsqrt_(x):
+    x._set_data(jax.lax.rsqrt(x._value()))
+    return x
+
+
+# ---- predicates (nondiff) ----------------------------------------------
+
+def isnan(x, name=None):
+    return nondiff("isnan", jnp.isnan, [x])
+
+
+def isinf(x, name=None):
+    return nondiff("isinf", jnp.isinf, [x])
+
+
+def isfinite(x, name=None):
+    return nondiff("isfinite", jnp.isfinite, [x])
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return nondiff(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [x, y],
+    )
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return nondiff(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [x, y],
+    )
+
+
+def equal_all(x, y, name=None):
+    return nondiff("equal_all", lambda a, b: jnp.array_equal(a, b), [x, y])
+
+
+# ---- reductions ---------------------------------------------------------
+
+def _norm_reduce_axis(x, axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = [int(v) for v in np.asarray(axis._value()).reshape(-1)]
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return op("sum", lambda a: jnp.sum(a, axis=axis, dtype=dt, keepdims=keepdim), [x])
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return op("mean", lambda a: jnp.mean(a, axis=axis, keepdims=keepdim), [x])
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return op("prod", lambda a: jnp.prod(a, axis=axis, dtype=dt, keepdims=keepdim), [x])
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return op("max", lambda a: jnp.max(a, axis=axis, keepdims=keepdim), [x])
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return op("min", lambda a: jnp.min(a, axis=axis, keepdims=keepdim), [x])
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    ddof = 1 if unbiased else 0
+    return op("std", lambda a: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    ddof = 1 if unbiased else 0
+    return op("var", lambda a: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return op("median", lambda a: jnp.median(a, axis=axis, keepdims=keepdim), [x])
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return op("nanmedian", lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), [x])
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return op("nansum", lambda a: jnp.nansum(a, axis=axis, dtype=dt, keepdims=keepdim), [x])
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return op("nanmean", lambda a: jnp.nanmean(a, axis=axis, keepdims=keepdim), [x])
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return op(
+        "logsumexp",
+        lambda a: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
+        [x],
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return nondiff("all", lambda a: jnp.all(a, axis=axis, keepdims=keepdim), [x])
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return nondiff("any", lambda a: jnp.any(a, axis=axis, keepdims=keepdim), [x])
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    axis = _norm_reduce_axis(x, axis)
+    return nondiff(
+        "count_nonzero", lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim), [x]
+    )
+
+
+# ---- scans --------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+
+    def primal(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=axis, dtype=dt)
+
+    return op("cumsum", primal, [x])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+
+    def primal(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=dt)
+        return jnp.cumprod(a, axis=dim, dtype=dt)
+
+    return op("cumprod", primal, [x])
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def primal(a):
+        ax = axis if axis is not None else 0
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.cummax(aa, axis=ax)
+        return vals
+
+    return op("cummax", primal, [x])
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def primal(a):
+        aa = a.reshape(-1) if axis is None else a
+        ax = axis if axis is not None else 0
+        return jax.lax.cumlogsumexp(aa, axis=ax)
+
+    return op("logcumsumexp", primal, [x])
+
+
+# ---- misc ---------------------------------------------------------------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return op(
+        "addmm",
+        lambda i, a, b: beta * i + alpha * (a @ b),
+        [input, x, y],
+    )
+
+
+def inner(x, y, name=None):
+    return op("inner", jnp.inner, [x, y])
+
+
+def outer(x, y, name=None):
+    return op("outer", lambda a, b: jnp.outer(a, b), [x, y])
+
+
+def kron(x, y, name=None):
+    return op("kron", jnp.kron, [x, y])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return op(
+        "trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), [x]
+    )
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if prepend is not None else None
+    app = unwrap(append) if append is not None else None
+    return op(
+        "diff", lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), [x]
+    )

@@ -1,0 +1,130 @@
+"""Random sampling ops over the stateful Generator (reference:
+python/paddle/tensor/random.py).  Each call consumes one split of the global
+generator key; the key state is a Tensor so random ops trace into to_static
+programs functionally."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+from ._helpers import unwrap, wrap, as_int_list
+
+
+def _dt(dtype):
+    return dtype_mod.convert_dtype(dtype) if dtype is not None else dtype_mod.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return wrap(jax.random.uniform(next_key(), as_int_list(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return wrap(jax.random.normal(next_key(), as_int_list(shape), dtype=_dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not hasattr(m, "shape") else m.shape,
+            np.shape(s) if not hasattr(s, "shape") else s.shape,
+        )
+        eps = jax.random.normal(next_key(), shp, dtype=dtype_mod.get_default_dtype())
+        return wrap(m + s * eps)
+    shp = as_int_list(shape) if shape is not None else []
+    eps = jax.random.normal(next_key(), shp, dtype=dtype_mod.get_default_dtype())
+    return wrap(mean + std * eps)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return wrap(
+        jax.random.uniform(key, as_int_list(shape), dtype=_dt(dtype), minval=min, maxval=max)
+    )
+
+
+def randint(low=0, high=None, shape=[1], dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return wrap(
+        jax.random.randint(
+            next_key(), as_int_list(shape), low, high, dtype=dtype_mod.convert_dtype(dtype)
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else x.dtype
+    if high is None:
+        low, high = 0, low
+    return wrap(jax.random.randint(next_key(), tuple(x.shape), low, high, dtype=dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return wrap(
+        jax.random.permutation(next_key(), n).astype(dtype_mod.convert_dtype(dtype))
+    )
+
+
+def bernoulli(x, name=None):
+    p = unwrap(x)
+    return wrap(jax.random.bernoulli(next_key(), p).astype(p.dtype))
+
+
+def poisson(x, name=None):
+    lam = unwrap(x)
+    return wrap(jax.random.poisson(next_key(), lam).astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = unwrap(x)
+    logits = jnp.log(jnp.clip(p, 1e-30, None))
+    if replacement:
+        # jax sample shape must end with the logits batch shape.
+        out = jax.random.categorical(
+            next_key(), logits, axis=-1, shape=(num_samples, *p.shape[:-1])
+        )
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k for sampling without replacement
+        g = jax.random.gumbel(next_key(), p.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return wrap(out.astype(np.int64))
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    x._set_data(
+        jax.random.uniform(next_key(), tuple(x.shape), dtype=x._value().dtype, minval=min, maxval=max)
+    )
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    eps = jax.random.normal(next_key(), tuple(x.shape), dtype=x._value().dtype)
+    x._set_data(mean + std * eps)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    e = jax.random.exponential(next_key(), tuple(x.shape), dtype=x._value().dtype)
+    x._set_data(e / lam)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else x.dtype
+    return wrap(jax.random.uniform(next_key(), tuple(x.shape), dtype=dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else x.dtype
+    return wrap(jax.random.normal(next_key(), tuple(x.shape), dtype=dt))

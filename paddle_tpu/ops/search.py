@@ -1,0 +1,152 @@
+"""Search / sort / index ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import unwrap, wrap, op, nondiff
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def primal(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape([1] * a.ndim) if keepdim else out
+        out = jnp.argmax(a, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return nondiff("argmax", lambda a: primal(a).astype(np.dtype(dtype)), [x])
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def primal(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape([1] * a.ndim) if keepdim else out
+        out = jnp.argmin(a, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return nondiff("argmin", lambda a: primal(a).astype(np.dtype(dtype)), [x])
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def primal(a):
+        idx = jnp.argsort(a, axis=axis)
+        return jnp.flip(idx, axis=axis) if descending else idx
+
+    return nondiff("argsort", lambda a: primal(a).astype(np.int32), [x])
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def primal(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return op("sort", primal, [x])
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def primal(a):
+        ax = axis if axis is not None else a.ndim - 1
+        ax = ax % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, k)
+        else:
+            vals, idx = jax.lax.top_k(-moved, k)
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(np.int32)
+
+    return op("topk", primal, [x], n_outs=2)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def primal(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(np.int32)
+
+    return op("kthvalue", primal, [x], n_outs=2)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(unwrap(x))
+    from scipy import stats as _stats  # lazy; cpu-side helper
+
+    vals, _ = _stats.mode(a, axis=axis, keepdims=True)
+    idx = np.argmax(np.asarray(a == vals), axis=axis)
+    vals = np.squeeze(vals, axis=axis)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idx = np.expand_dims(idx, axis)
+    return wrap(jnp.asarray(vals)), wrap(jnp.asarray(idx.astype(np.int32)))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    a = np.asarray(unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(v.astype(np.int32))[:, None]) for v in nz)
+    return wrap(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    cond = unwrap(condition)
+    return op("where", lambda a, b: jnp.where(cond, a, b), [x, y])
+
+
+def masked_scatter(x, mask, value, name=None):
+    m = np.asarray(unwrap(mask))
+
+    def primal(a, v):
+        mb = np.broadcast_to(m, a.shape)
+        flat_idx = jnp.asarray(np.flatnonzero(mb))
+        n = int(mb.sum())
+        return a.reshape(-1).at[flat_idx].set(v.reshape(-1)[:n]).reshape(a.shape)
+
+    return op("masked_scatter", primal, [x, value])
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    seq = unwrap(sorted_sequence)
+    side = "right" if right else "left"
+
+    def primal(v):
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jnp.stack(
+                [jnp.searchsorted(seq[i], v[i], side=side) for i in range(seq.shape[0])]
+            )
+        return out.astype(np.int32)
+
+    return nondiff("searchsorted", primal, [values])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    idx = unwrap(index)
+
+    def primal(a):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].set(jnp.asarray(value, a.dtype))
+
+    return op("index_fill", primal, [x])

@@ -1,0 +1,27 @@
+"""Test config: force the CPU backend with 8 virtual devices BEFORE jax import
+so distributed/sharding tests exercise a multi-chip mesh without TPU hardware
+(mirrors the reference's single-host multi-process test strategy,
+SURVEY.md §4)."""
+import os
+
+# Force CPU for tests unless explicitly overridden (PADDLE_TPU_TEST_PLATFORM).
+_plat = os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _plat
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Numerics tests compare against float64 numpy: keep matmuls in true f32
+# (production default is TPU-fast bf16-accumulate; SURVEY.md §7 "f32 shadow
+# paths for tests").
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+# The axon sitecustomize (TPU tunnel) force-registers its platform and sets
+# jax_platforms="axon,cpu" regardless of env; override via the config API
+# before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _plat)
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,139 @@
+"""Tape autograd tests (reference analog: eager backward tests,
+eager/backward.cc:532 semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.exp(x)
+    z = (y * 3.0).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0 * np.exp([1.0, 2.0]).astype(np.float32), rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x  # used twice
+    z = (y + y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32), stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = (y * 3).sum()
+    z.backward()
+    assert x.grad is None
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=False)
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 3.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 6.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    parts = paddle.split(x, 2)
+    loss = (parts[0] * 2).sum() + (parts[1] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    y = x[1:3].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1, 0])
+
+
+def test_setitem_grad_flows_to_value():
+    x = paddle.to_tensor(np.zeros((4,), np.float32), stop_gradient=False)
+    v = paddle.to_tensor([5.0], stop_gradient=False)
+    x[1] = v
+    x.sum().backward()
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])

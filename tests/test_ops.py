@@ -1,0 +1,273 @@
+"""Broad op-surface tests vs numpy (reference analog: OpTest check_output)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        np.testing.assert_allclose(paddle.ones([2]).numpy(), [1, 1])
+        np.testing.assert_allclose(paddle.full([2], 7).numpy(), [7, 7])
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.arange(1, 7, 2).numpy(), [1, 3, 5])
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        x = t(np.arange(9).reshape(3, 3))
+        np.testing.assert_allclose(paddle.tril(x).numpy(), np.tril(x.numpy()))
+        np.testing.assert_allclose(paddle.triu(x).numpy(), np.triu(x.numpy()))
+
+    def test_like_family(self):
+        x = t(np.ones((2, 2)))
+        assert paddle.zeros_like(x).shape == [2, 2]
+        assert paddle.full_like(x, 3).numpy()[0, 0] == 3
+
+
+class TestMath:
+    def test_elementwise(self):
+        x = t([1.0, 4.0, 9.0])
+        np.testing.assert_allclose(paddle.sqrt(x).numpy(), [1, 2, 3])
+        np.testing.assert_allclose(paddle.rsqrt(x).numpy(), [1, 0.5, 1 / 3], rtol=1e-6)
+        np.testing.assert_allclose(paddle.square(x).numpy(), [1, 16, 81])
+        np.testing.assert_allclose(
+            paddle.log(x).numpy(), np.log([1, 4, 9]), rtol=1e-6
+        )
+
+    def test_clip(self):
+        x = t([-1.0, 0.5, 2.0])
+        np.testing.assert_allclose(paddle.clip(x, 0.0, 1.0).numpy(), [0, 0.5, 1])
+
+    def test_reductions(self):
+        x = t(np.arange(6).reshape(2, 3))
+        assert paddle.sum(x).item() == 15
+        np.testing.assert_allclose(paddle.sum(x, axis=0).numpy(), [3, 5, 7])
+        np.testing.assert_allclose(paddle.mean(x, axis=1).numpy(), [1, 4])
+        assert paddle.max(x).item() == 5
+        assert paddle.prod(t([2.0, 3.0])).item() == 6
+        np.testing.assert_allclose(
+            paddle.sum(x, axis=1, keepdim=True).numpy(), [[3], [12]]
+        )
+
+    def test_cumsum(self):
+        x = t([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(paddle.cumsum(x).numpy(), [1, 3, 6])
+
+    def test_logsumexp(self):
+        x = t([1.0, 2.0])
+        expect = np.log(np.exp(1) + np.exp(2))
+        np.testing.assert_allclose(paddle.logsumexp(x).numpy(), expect, rtol=1e-6)
+
+    def test_scale(self):
+        x = t([1.0, 2.0])
+        np.testing.assert_allclose(paddle.scale(x, 2.0, 1.0).numpy(), [3, 5])
+        np.testing.assert_allclose(
+            paddle.scale(x, 2.0, 1.0, bias_after_scale=False).numpy(), [4, 6]
+        )
+
+
+class TestManipulation:
+    def test_reshape_paddle_zero_semantics(self):
+        x = t(np.zeros((2, 3, 4)))
+        assert paddle.reshape(x, [0, 12]).shape == [2, 12]
+        assert paddle.reshape(x, [-1, 6]).shape == [4, 6]
+
+    def test_transpose_squeeze(self):
+        x = t(np.zeros((2, 1, 3)))
+        assert paddle.transpose(x, [2, 0, 1]).shape == [3, 2, 1]
+        assert paddle.squeeze(x, 1).shape == [2, 3]
+        assert paddle.unsqueeze(x, 0).shape == [1, 2, 1, 3]
+
+    def test_concat_stack_split(self):
+        a, b = t([[1.0, 2]]), t([[3.0, 4]])
+        np.testing.assert_allclose(paddle.concat([a, b], 0).numpy(), [[1, 2], [3, 4]])
+        assert paddle.stack([a, b], 0).shape == [2, 1, 2]
+        parts = paddle.split(t(np.arange(6)), [2, 4])
+        assert parts[0].shape == [2] and parts[1].shape == [4]
+        parts = paddle.split(t(np.arange(6)), 3)
+        assert len(parts) == 3
+
+    def test_tile_expand(self):
+        x = t([[1.0, 2]])
+        assert paddle.tile(x, [2, 2]).shape == [2, 4]
+        assert paddle.expand(x, [3, 2]).shape == [3, 2]
+        assert paddle.broadcast_to(x, [4, 2]).shape == [4, 2]
+
+    def test_gather_scatter(self):
+        x = t(np.arange(12).reshape(4, 3))
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_allclose(paddle.gather(x, idx).numpy(), [[0, 1, 2], [6, 7, 8]])
+        upd = t([[10.0, 10, 10]])
+        out = paddle.scatter(x, paddle.to_tensor([1]), upd)
+        np.testing.assert_allclose(out.numpy()[1], [10, 10, 10])
+
+    def test_gather_nd(self):
+        x = t(np.arange(8).reshape(2, 2, 2))
+        idx = paddle.to_tensor([[0, 1], [1, 0]])
+        np.testing.assert_allclose(paddle.gather_nd(x, idx).numpy(), [[2, 3], [4, 5]])
+
+    def test_flip_roll(self):
+        x = t([1.0, 2, 3])
+        np.testing.assert_allclose(paddle.flip(x, 0).numpy(), [3, 2, 1])
+        np.testing.assert_allclose(paddle.roll(x, 1).numpy(), [3, 1, 2])
+
+    def test_unique(self):
+        x = paddle.to_tensor([3, 1, 2, 1, 3])
+        np.testing.assert_array_equal(paddle.unique(x).numpy(), [1, 2, 3])
+
+    def test_flatten(self):
+        x = t(np.zeros((2, 3, 4)))
+        assert paddle.flatten(x).shape == [24]
+        assert paddle.flatten(x, 1).shape == [2, 12]
+
+    def test_take_put_along_axis(self):
+        x = t([[1.0, 2], [3, 4]])
+        idx = paddle.to_tensor(np.array([[0], [1]]))
+        np.testing.assert_allclose(
+            paddle.take_along_axis(x, idx, 1).numpy(), [[1], [4]]
+        )
+
+    def test_masked_ops(self):
+        x = t([1.0, 2, 3, 4])
+        mask = paddle.to_tensor([True, False, True, False])
+        np.testing.assert_allclose(paddle.masked_select(x, mask).numpy(), [1, 3])
+        np.testing.assert_allclose(
+            paddle.masked_fill(x, mask, -1.0).numpy(), [-1, 2, -1, 4]
+        )
+
+
+class TestLinalg:
+    def test_matmul_transpose_flags(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(5, 4).astype(np.float32)
+        out = paddle.matmul(t(a), t(b), transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a @ b.T, rtol=1e-5)
+
+    def test_batched_matmul(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        b = np.random.rand(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.bmm(t(a), t(b)).numpy(), a @ b, rtol=1e-5
+        )
+
+    def test_norm(self):
+        x = t([[3.0, 4.0]])
+        np.testing.assert_allclose(paddle.norm(x).item(), 5.0, rtol=1e-6)
+        np.testing.assert_allclose(paddle.norm(x, p=1).item(), 7.0, rtol=1e-6)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, rtol=1e-5
+        )
+
+    def test_solve_inv(self):
+        a = np.array([[2.0, 0], [0, 4.0]], dtype=np.float32)
+        b = np.array([[2.0], [8.0]], dtype=np.float32)
+        np.testing.assert_allclose(paddle.linalg.solve(t(a), t(b)).numpy(), [[1], [2]], rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(t(a)).numpy(), np.linalg.inv(a), rtol=1e-5
+        )
+
+    def test_svd_qr(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        u, s, vt = paddle.linalg.svd(t(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), a, rtol=1e-4, atol=1e-5
+        )
+        q, r = paddle.linalg.qr(t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-5)
+
+
+class TestSearch:
+    def test_argmax_topk(self):
+        x = t([[1.0, 5, 3], [9, 2, 8]])
+        np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(), [1, 0])
+        vals, idx = paddle.topk(x, 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), [[5, 3], [9, 8]])
+        np.testing.assert_array_equal(idx.numpy(), [[1, 2], [0, 2]])
+
+    def test_sort_argsort(self):
+        x = t([3.0, 1, 2])
+        np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 2, 3])
+        np.testing.assert_array_equal(paddle.argsort(x).numpy(), [1, 2, 0])
+        np.testing.assert_allclose(
+            paddle.sort(x, descending=True).numpy(), [3, 2, 1]
+        )
+
+    def test_where_nonzero(self):
+        x = t([1.0, -1, 2])
+        out = paddle.where(x > 0, x, paddle.zeros_like(x))
+        np.testing.assert_allclose(out.numpy(), [1, 0, 2])
+        nz = paddle.nonzero(x > 0)
+        np.testing.assert_array_equal(nz.numpy(), [[0], [2]])
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.rand([3])
+        paddle.seed(42)
+        b = paddle.rand([3])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_distributions(self):
+        paddle.seed(0)
+        u = paddle.uniform([10000], min=0.0, max=1.0)
+        assert 0.45 < u.mean().item() < 0.55
+        n = paddle.randn([10000])
+        assert abs(n.mean().item()) < 0.05
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(10))
+
+    def test_rng_state_roundtrip(self):
+        paddle.seed(7)
+        st = paddle.get_rng_state()
+        a = paddle.rand([2])
+        paddle.set_rng_state(st)
+        b = paddle.rand([2])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+class TestGradNumeric:
+    """Numeric-vs-analytic gradient checks (reference: OpTest.check_grad)."""
+
+    @pytest.mark.parametrize(
+        "op,arg",
+        [
+            (paddle.tanh, [0.3, -0.7]),
+            (paddle.exp, [0.1, 0.5]),
+            (paddle.sigmoid, [0.2, -0.4]),
+            (paddle.sqrt, [1.0, 4.0]),
+            (paddle.log, [1.0, 2.0]),
+            (lambda x: paddle.clip(x, -0.5, 0.5), [0.2, 0.9]),
+        ],
+    )
+    def test_unary_numeric_grad(self, op, arg):
+        x = paddle.to_tensor(np.asarray(arg, np.float32), stop_gradient=False)
+        op(x).sum().backward()
+        analytic = x.grad.numpy()
+        eps = 1e-3
+        num = []
+        for i in range(len(arg)):
+            ap = np.asarray(arg, np.float64)
+            am = ap.copy()
+            ap[i] += eps
+            am[i] -= eps
+            fp = op(paddle.to_tensor(ap.astype(np.float32))).sum().item()
+            fm = op(paddle.to_tensor(am.astype(np.float32))).sum().item()
+            num.append((fp - fm) / (2 * eps))
+        np.testing.assert_allclose(analytic, num, rtol=1e-2, atol=1e-3)

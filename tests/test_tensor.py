@@ -1,0 +1,101 @@
+"""Core Tensor semantics tests (reference analog: eager Tensor tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == np.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_dtype_cast():
+    x = paddle.to_tensor(np.zeros((3,), dtype=np.float64))
+    assert x.dtype == np.float32  # python float64 data → default dtype
+    paddle.set_default_dtype("bfloat16")
+    try:
+        y = paddle.to_tensor([1.0, 2.0])
+        assert y.dtype == paddle.bfloat16
+    finally:
+        paddle.set_default_dtype("float32")
+
+
+def test_int_dtype():
+    # 64-bit canonicalizes to 32-bit (TPU-native; x64 disabled).
+    x = paddle.to_tensor([1, 2, 3])
+    assert x.dtype == np.int32
+
+
+def test_item_and_scalar():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == pytest.approx(3.5)
+    assert float(x) == pytest.approx(3.5)
+
+
+def test_arithmetic_dunders():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((x + y).numpy(), [4, 6])
+    np.testing.assert_allclose((x - y).numpy(), [-2, -2])
+    np.testing.assert_allclose((x * y).numpy(), [3, 8])
+    np.testing.assert_allclose((y / x).numpy(), [3, 2])
+    np.testing.assert_allclose((x**2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 * x).numpy(), [2, 4])
+    np.testing.assert_allclose((1.0 - x).numpy(), [0, -1])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2])
+
+
+def test_comparison():
+    x = paddle.to_tensor([1.0, 5.0])
+    y = paddle.to_tensor([2.0, 2.0])
+    np.testing.assert_array_equal((x < y).numpy(), [True, False])
+    np.testing.assert_array_equal((x >= y).numpy(), [False, True])
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, ::2].numpy(), [[4, 6], [8, 10]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    x = paddle.to_tensor(np.zeros((3, 3), dtype=np.float32))
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = 1.0
+    assert x.numpy()[0, 0] == 1.0
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    x += 1.0
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.set_value(np.array([7.0, 8.0], dtype=np.float32))
+    np.testing.assert_allclose(x.numpy(), [7, 8])
+    assert x.inplace_version() >= 2
+
+
+def test_astype_and_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = paddle.cast(x, paddle.bfloat16)
+    assert z.dtype == paddle.bfloat16
+
+
+def test_detach_and_clone():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient
+
+
+def test_repr_does_not_crash():
+    assert "Tensor" in repr(paddle.to_tensor([1.0]))
