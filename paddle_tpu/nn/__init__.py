@@ -1,0 +1,51 @@
+"""paddle.nn surface (reference: python/paddle/nn/__init__.py)."""
+from .layer_base import Layer, Parameter, ParamAttr
+from . import initializer
+from . import functional
+from .clip import (
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
+)
+
+from .layer.container import Sequential, LayerList, LayerDict, ParameterList
+from .layer.common import (
+    Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D, Bilinear, CosineSimilarity, PairwiseDistance,
+    PixelShuffle, PixelUnshuffle, ChannelShuffle, Unfold, Fold,
+)
+from .layer.activation import (
+    CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
+    LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, RReLU,
+    SELU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh,
+    Tanhshrink, ThresholdedReLU,
+)
+from .layer.conv import (
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.norm import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm, SpectralNorm,
+)
+from .layer.pooling import (
+    AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layer.loss import (
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, TripletMarginWithDistanceLoss,
+    SoftMarginLoss, MultiLabelSoftMarginLoss, CTCLoss, PoissonNLLLoss,
+    GaussianNLLLoss,
+)
+from .layer.rnn import (
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU,
+)
+from .layer.transformer import (
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+
+from . import utils

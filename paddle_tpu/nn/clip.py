@@ -1,0 +1,113 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm).
+
+Clips operate on (param, grad) lists inside the optimizer step; the math is
+pure-jax so a jitted train step fuses the global-norm reduction.  In hybrid
+parallel, HybridParallelOptimizer wraps ClipGradByGlobalNorm to sum the
+squared norms across mp/pp/sharding groups (dygraph_optimizer/
+hybrid_parallel_optimizer.py:41) — that behavior lives in
+distributed.fleet.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import op
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, op("clip_grad_value",
+                              lambda a: jnp.clip(a, self.min, self.max), [g])))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def _primal(a):
+                nrm = jnp.sqrt(jnp.sum(jnp.square(a)))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(nrm, 1e-12), 1.0)
+                return a * scale
+
+            out.append((p, op("clip_grad_norm", _primal, [g])))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _global_norm_sq(self, grads):
+        """Sum of squared norms; override point for distributed clip."""
+        total = None
+        for g in grads:
+            s = jnp.sum(jnp.square(g._value().astype(jnp.float32)))
+            total = s if total is None else total + s
+        return total
+
+    def _dygraph_clip(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        total = self._global_norm_sq(grads)
+        global_norm = jnp.sqrt(total)
+        scale = jnp.minimum(
+            self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0
+        )
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._wrap(g._value() * scale.astype(g._value().dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style utility (paddle.nn.utils.clip_grad_norm_)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value())) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._value().astype(jnp.float32)), norm_type))
+                for g in grads),
+            1.0 / norm_type,
+        )
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p._grad = p._grad * clip_coef.astype(p._grad.dtype)
+    return Tensor._wrap(total)

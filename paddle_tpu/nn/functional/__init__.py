@@ -1,0 +1,39 @@
+"""paddle.nn.functional surface (reference: python/paddle/nn/functional)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import op, nondiff
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core import dtype as dtype_mod
+
+    def _primal(lengths):
+        ml = maxlen if maxlen is not None else int(jnp.max(lengths))
+        rng = jnp.arange(ml)
+        return (rng[None, :] < lengths[..., None]).astype(dtype_mod.convert_dtype(dtype))
+
+    return nondiff("sequence_mask", _primal, [x])
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Fused attention entry point: pallas flash-attention when available on
+    TPU, XLA fallback otherwise (reference: fused_attention_op semantics,
+    operators/fused/fused_attention_op.cu — re-designed, not translated).
+
+    Layout: [batch, seq, heads, head_dim] (paddle convention).
+    """
+    from ...ops.pallas import flash_attention
+
+    return flash_attention(query, key, value, attn_mask=attn_mask,
+                           dropout_p=dropout_p, is_causal=is_causal,
+                           training=training)

@@ -1,0 +1,232 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All are pure-jax primals dispatched through the tape; XLA fuses them into
+adjacent matmuls on TPU, so there are no hand-written activation kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "swish", "mish", "softplus", "softsign", "softshrink", "hardshrink",
+    "tanhshrink", "hardtanh", "hardsigmoid", "hardswish", "leaky_relu",
+    "log_sigmoid", "prelu", "rrelu", "maxout", "glu", "softmax", "softmax_",
+    "log_softmax", "gumbel_softmax", "sigmoid", "tanh", "thresholded_relu",
+]
+
+
+def relu(x, name=None):
+    return op("relu", jax.nn.relu, [x])
+
+
+def relu_(x, name=None):
+    return x._rebind_from(relu(x))
+
+
+def relu6(x, name=None):
+    return op("relu6", lambda a: jnp.clip(a, 0.0, 6.0), [x])
+
+
+def elu(x, alpha=1.0, name=None):
+    return op("elu", lambda a: jax.nn.elu(a, alpha=alpha), [x])
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind_from(elu(x, alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return op(
+        "selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), [x]
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return op("celu", lambda a: jax.nn.celu(a, alpha=alpha), [x])
+
+
+def gelu(x, approximate=False, name=None):
+    return op("gelu", lambda a: jax.nn.gelu(a, approximate=bool(approximate)), [x])
+
+
+def silu(x, name=None):
+    return op("silu", jax.nn.silu, [x])
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), [x])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def _primal(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jax.nn.softplus(scaled) / beta)
+
+    return op("softplus", _primal, [x])
+
+
+def softsign(x, name=None):
+    return op("softsign", jax.nn.soft_sign, [x])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    def _primal(a):
+        return jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        )
+
+    return op("softshrink", _primal, [x])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return op(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+        [x],
+    )
+
+
+def tanhshrink(x, name=None):
+    return op("tanhshrink", lambda a: a - jnp.tanh(a), [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return op("hardtanh", lambda a: jnp.clip(a, min, max), [x])
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return op(
+        "hardsigmoid", lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), [x]
+    )
+
+
+def hardswish(x, name=None):
+    return op(
+        "hardswish",
+        lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0,
+        [x],
+    )
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return op(
+        "leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), [x]
+    )
+
+
+def log_sigmoid(x, name=None):
+    return op("log_sigmoid", jax.nn.log_sigmoid, [x])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _primal(a, w):
+        if w.size > 1:
+            # per-channel weight broadcast along the channel axis
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+
+    return op("prelu", _primal, [x, weight])
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    from ...core import rng as rng_mod
+
+    if training:
+        key = rng_mod.next_key()
+
+        def _primal(a, k):
+            slope = jax.random.uniform(
+                k, a.shape, dtype=jnp.float32, minval=lower, maxval=upper
+            ).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+
+        return op("rrelu", _primal, [x, key])
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return op(
+        "thresholded_relu", lambda a: jnp.where(a > threshold, a, 0.0), [x]
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _primal(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(new_shape), axis=ax)
+
+    return op("maxout", _primal, [x])
+
+
+def glu(x, axis=-1, name=None):
+    return op("glu", lambda a: jax.nn.glu(a, axis=axis), [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    def _primal(a):
+        if dtype is not None:
+            a = a.astype(dtype_mod.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return op("softmax", _primal, [x])
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind_from(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    def _primal(a):
+        if dtype is not None:
+            a = a.astype(dtype_mod.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return op("log_softmax", _primal, [x])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng as rng_mod
+
+    key = rng_mod.next_key()
+
+    def _primal(a, k):
+        g = -jnp.log(-jnp.log(jax.random.uniform(k, a.shape, dtype=jnp.float32) + 1e-20) + 1e-20)
+        soft = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
+        if not hard:
+            return soft
+        idx = jnp.argmax(soft, axis=axis, keepdims=True)
+        iota = jnp.arange(soft.shape[axis]).reshape(
+            [-1 if i == (axis % soft.ndim) else 1 for i in range(soft.ndim)]
+        )
+        one_hot = jnp.where(iota == idx, 1.0, 0.0).astype(soft.dtype)
+        # straight-through estimator: hard sample fwd, soft gradient bwd
+        return one_hot + soft - jax.lax.stop_gradient(soft)
+
+    return op("gumbel_softmax", _primal, [x, key])
+
+
+def sigmoid(x, name=None):
+    return op("sigmoid", jax.nn.sigmoid, [x])
+
+
+def tanh(x, name=None):
+    return op("tanh", jnp.tanh, [x])

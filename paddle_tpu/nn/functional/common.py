@@ -1,0 +1,386 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate...
+
+Reference parity: python/paddle/nn/functional/common.py + input.py.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import op, nondiff
+from ...core.tensor import Tensor
+from ...core import rng as rng_mod
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "zeropad2d", "cosine_similarity",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "interpolate",
+    "upsample", "unfold", "fold", "label_smooth", "class_center_sample",
+    "temporal_shift", "npair_loss",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's [in, out] weight layout (nn/functional/common.py)."""
+
+    def _primal(a, w, *maybe_b):
+        out = jnp.matmul(a, w)
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return op("linear", _primal, args)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return op("dropout_scale", lambda a: a * (1.0 - p), [x])
+        return x
+    if p == 1.0:
+        return op("dropout", lambda a: jnp.zeros_like(a), [x])
+    key = rng_mod.next_key()
+
+    def _primal(a, k):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        out = jnp.where(keep, a, jnp.zeros((), dtype=a.dtype))
+        if mode == "upscale_in_train":
+            out = out / (1.0 - p)
+        return out
+
+    return op("dropout", _primal, [x, key])
+
+
+def _dropout_nd(x, p, training, data_format, nd, name):
+    if not training or p == 0.0:
+        return x
+    key = rng_mod.next_key()
+    channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+
+    def _primal(a, k):
+        shape = list(a.shape)
+        if channel_last:
+            mask_shape = shape[:1] + [1] * nd + shape[-1:]
+        else:
+            mask_shape = shape[:2] + [1] * nd
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(mask_shape))
+        return jnp.where(keep, a / (1.0 - p), jnp.zeros((), dtype=a.dtype))
+
+    return op("dropout_nd", _primal, [x, key])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return _dropout_nd(x, p, training, data_format, 2, name)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return _dropout_nd(x, p, training, data_format, 3, name)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rng_mod.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _primal(a, k):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        a_coef = (1.0 - p + p * alpha_p**2 * (1.0 - p)) ** -0.5
+        b_coef = -a_coef * p * alpha_p
+        return a_coef * jnp.where(keep, a, jnp.full((), alpha_p, dtype=a.dtype)) + b_coef
+
+    return op("alpha_dropout", _primal, [x, key])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows of ``weight`` (reference: functional/input.py embedding).
+
+    padding_idx rows contribute zero gradient (matched by zeroing that row's
+    cotangent via a mask inside the primal).
+    """
+
+    def _primal(ids, w):
+        if padding_idx is not None:
+            pidx = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (jnp.arange(w.shape[0]) != pidx).astype(w.dtype)[:, None]
+            w = w * mask
+        return jnp.take(w, ids.astype(jnp.int32), axis=0)
+
+    return op("embedding", _primal, [x, weight])
+
+
+def one_hot(x, num_classes, name=None):
+    return nondiff(
+        "one_hot",
+        lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes, dtype=jnp.float32),
+        [x],
+    )
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops._helpers import as_int_list
+
+    pad_list = as_int_list(pad)
+
+    def _primal(a):
+        nd = a.ndim
+        if len(pad_list) == 2 * nd:
+            # full-rank paddle order: [d0_l, d0_r, d1_l, d1_r, ...]
+            pairs = [(pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)]
+        else:
+            # spatial-only pairs, innermost-last (torch style, used by paddle
+            # for NCHW: [w_l, w_r, h_t, h_b])
+            n_spatial = len(pad_list) // 2
+            pairs = [(0, 0)] * nd
+            channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+            spatial_axes = (
+                list(range(1, 1 + (nd - 2))) if channel_last else list(range(2, nd))
+            )
+            for i in range(n_spatial):
+                ax = spatial_axes[len(spatial_axes) - 1 - i]
+                pairs[ax] = (pad_list[2 * i], pad_list[2 * i + 1])
+        jmode = {
+            "constant": "constant",
+            "reflect": "reflect",
+            "replicate": "edge",
+            "circular": "wrap",
+        }[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return op("pad", _primal, [x])
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _primal(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+
+    return op("cosine_similarity", _primal, [x1, x2])
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _primal(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h * r, w * r, c // (r * r))
+
+    return op("pixel_shuffle", _primal, [x])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _primal(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h // r, w // r, c * r * r)
+
+    return op("pixel_unshuffle", _primal, [x])
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _primal(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, groups, c // groups, h, w)
+            out = jnp.transpose(out, (0, 2, 1, 3, 4))
+            return out.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, groups, c // groups)
+        out = jnp.transpose(out, (0, 1, 2, 4, 3))
+        return out.reshape(n, h, w, c)
+
+    return op("channel_shuffle", _primal, [x])
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """Resize via jax.image.resize (XLA gather/conv lowering)."""
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+
+    def _primal(a):
+        nd = a.ndim - 2
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+        if size is not None:
+            out_spatial = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+            if len(out_spatial) == 1 and nd > 1:
+                out_spatial = out_spatial * nd
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+            out_spatial = [int(s * f) for s, f in zip(spatial, sf)]
+        if channel_last:
+            out_shape = (a.shape[0],) + tuple(out_spatial) + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + tuple(out_spatial)
+        method = {
+            "nearest": "nearest",
+            "bilinear": "bilinear",
+            "linear": "linear" if nd == 1 else "bilinear",
+            "trilinear": "trilinear",
+            "bicubic": "bicubic",
+            "area": "linear",
+        }[mode]
+        if method == "trilinear":
+            method = "linear"
+        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+
+    return op("interpolate", _primal, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: nn/functional/common.py unfold)."""
+    from .conv import _ntuple
+
+    k = _ntuple(kernel_sizes, 2)
+    s = _ntuple(strides, 2)
+    p = _ntuple(paddings, 2) if not isinstance(paddings, (list, tuple)) or len(paddings) <= 2 else None
+    if p is None:
+        pl = list(paddings)
+        pads = [(pl[0], pl[2]), (pl[1], pl[3])] if len(pl) == 4 else [(pl[0], pl[0]), (pl[1], pl[1])]
+    else:
+        pads = [(p[0], p[0]), (p[1], p[1])]
+    d = _ntuple(dilations, 2)
+
+    def _primal(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding=pads, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        # patches: [N, C*kh*kw, oh, ow] → [N, C*kh*kw, L]
+        return patches.reshape(n, c * k[0] * k[1], -1)
+
+    return op("unfold", _primal, [x])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im: scatter-add patches back (adjoint of unfold)."""
+    from .conv import _ntuple
+
+    out_sz = _ntuple(output_sizes, 2)
+    k = _ntuple(kernel_sizes, 2)
+    s = _ntuple(strides, 2)
+    pd = _ntuple(paddings, 2)
+    d = _ntuple(dilations, 2)
+
+    def _primal(col):
+        n, ckk, L = col.shape
+        c = ckk // (k[0] * k[1])
+        # use the VJP of unfold's patch extraction for exact col2im
+        def _unf(img):
+            patches = jax.lax.conv_general_dilated_patches(
+                img, filter_shape=k, window_strides=s,
+                padding=[(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=d,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            )
+            return patches.reshape(n, ckk, -1)
+
+        zero = jnp.zeros((n, c, out_sz[0], out_sz[1]), dtype=col.dtype)
+        _, vjp = jax.vjp(_unf, zero)
+        return vjp(col)[0]
+
+    return op("fold", _primal, [x])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _primal(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return op("label_smooth", _primal, args)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (PartialFC); simplified eager impl."""
+    lab = np.asarray(label.numpy()).reshape(-1)
+    pos = np.unique(lab)
+    n_extra = max(0, num_samples - len(pos))
+    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.default_rng(0)
+    extra = rng.choice(neg_pool, size=min(n_extra, len(neg_pool)), replace=False)
+    sampled = np.sort(np.concatenate([pos, extra]))
+    remap = {c: i for i, c in enumerate(sampled)}
+    remapped = np.array([remap[c] for c in lab], dtype=np.int64)
+    return (
+        Tensor._wrap(jnp.asarray(remapped)),
+        Tensor._wrap(jnp.asarray(sampled.astype(np.int64))),
+    )
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def _primal(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        r = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate([r[:, 1:, :fold_c], jnp.zeros_like(r[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(r[:, :1, fold_c:2 * fold_c]), r[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = r[:, :, 2 * fold_c:]
+        out = jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return op("temporal_shift", _primal, [x])
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _primal(a, p, l):
+        batch = a.shape[0]
+        sim = jnp.matmul(a, p.T)
+        lbl = l.reshape(-1)
+        target = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+        target = target / jnp.sum(target, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), axis=1)) +
+                        jnp.mean(jnp.sum(jnp.square(p), axis=1))) / 2
+        return ce + reg
+
+    return op("npair_loss", _primal, [anchor, positive, labels])

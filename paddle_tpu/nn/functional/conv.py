@@ -1,0 +1,199 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+Reference parity: python/paddle/nn/functional/conv.py (conv1d/2d/3d +
+transpose variants, NCHW/NHWC data formats, grouped and dilated conv).
+TPU-native design: one call to ``lax.conv_general_dilated`` — XLA tiles it
+onto the MXU directly; no im2col or per-backend kernels.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._helpers import op
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        raise ValueError(f"expected {n} values, got {v}")
+    return tuple(int(v) for _ in range(n))
+
+
+def _resolve_padding(padding, n):
+    """Paddle padding: int, list of ints, 'SAME'/'VALID', or explicit pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n and all(isinstance(p, int) for p in flat):
+            return [(p, p) for p in flat]
+        if len(flat) == 2 * n:
+            return [(flat[2 * i], flat[2 * i + 1]) for i in range(n)]
+        if len(flat) == 1:
+            return [(flat[0], flat[0])] * n
+        # nested [[l, r], ...]
+        if all(isinstance(p, (list, tuple)) for p in flat):
+            pairs = [tuple(p) for p in flat]
+            if len(pairs) == n + 2:  # includes batch/channel dims
+                pairs = pairs[2:] if pairs[0] == (0, 0) else pairs[1:-1]
+            return pairs
+    return [(int(padding), int(padding))] * n
+
+
+def _dim_numbers(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(name, x, weight, bias, stride, padding, dilation, groups, data_format, nd):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    strides = _ntuple(stride, nd)
+    dils = _ntuple(dilation, nd)
+    pads = _resolve_padding(padding, nd)
+    dn_spec = _dim_numbers(nd, channel_last)
+
+    def _primal(a, w, *maybe_b):
+        # paddle weight layout is [out_c, in_c/groups, *k]; lax OIHW matches,
+        # channel-last spec wants HWIO
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_spec)
+        out = lax.conv_general_dilated(
+            a, w,
+            window_strides=strides,
+            padding=pads,
+            rhs_dilation=dils,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return op(name, _primal, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv("conv1d", x, weight, bias, stride, padding, dilation, groups, fmt, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv("conv2d", x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv("conv3d", x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3)
+
+
+def _conv_transpose(name, x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, nd, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    strides = _ntuple(stride, nd)
+    dils = _ntuple(dilation, nd)
+    pads = _resolve_padding(padding, nd)
+    out_pads = _ntuple(output_padding, nd)
+    dn_spec = _dim_numbers(nd, channel_last)
+
+    def _primal(a, w, *maybe_b):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        k_dims = tuple(w.shape[2:])
+        if isinstance(pads, str):
+            pad_pairs = None  # handled by lax with string padding
+        else:
+            # gradient-of-conv padding: p' = dilation*(k-1) - p
+            pad_pairs = [
+                (
+                    dils[i] * (k_dims[i] - 1) - pads[i][0],
+                    dils[i] * (k_dims[i] - 1) - pads[i][1] + out_pads[i],
+                )
+                for i in range(nd)
+            ]
+        if groups > 1:
+            # grouped transposed conv: split along in-channel axis
+            a_groups = jnp.split(a, groups, axis=-1 if channel_last else 1)
+            w_groups = jnp.split(w, groups, axis=0)
+            outs = [
+                _one(a_g, w_g, pad_pairs)
+                for a_g, w_g in zip(a_groups, w_groups)
+            ]
+            out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        else:
+            out = _one(a, w, pad_pairs)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    def _one(a, w, pad_pairs):
+        # express as lhs-dilated conv with flipped kernel (the true gradient)
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        # IO ↔ OI swap: transpose-conv weight [in, out, *k] → conv [out, in, *k]
+        w_t = jnp.swapaxes(w_flip, 0, 1)
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            w_t = jnp.transpose(w_t, perm)
+        dn = lax.conv_dimension_numbers(a.shape, w_t.shape, dn_spec)
+        return lax.conv_general_dilated(
+            a, w_t,
+            window_strides=(1,) * nd,
+            padding=pad_pairs if pad_pairs is not None else "SAME",
+            lhs_dilation=strides,
+            rhs_dilation=dils,
+            dimension_numbers=dn,
+        )
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return op(name, _primal, args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose("conv1d_transpose", x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, fmt, 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose("conv2d_transpose", x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, data_format, 2,
+                           output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose("conv3d_transpose", x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, data_format, 3,
+                           output_size)

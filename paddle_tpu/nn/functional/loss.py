@@ -1,0 +1,390 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py).
+
+cross_entropy mirrors the reference's fused softmax_with_cross_entropy
+semantics (soft/hard labels, ignore_index, axis, weight) — on TPU the fusion
+is XLA's job, the math lives here once.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "dice_loss", "ctc_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    def _primal(logits, lbl, *maybe_w):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30)
+        )
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=ax)
+            if maybe_w:
+                w = jnp.sum(lbl * maybe_w[0].reshape(
+                    [-1 if i == ax else 1 for i in range(logits.ndim)]), axis=ax)
+                loss = loss * w
+            return _reduce(loss, reduction)
+        lbl_i = lbl.astype(jnp.int32)
+        if lbl_i.ndim == logp.ndim:
+            lbl_i = jnp.squeeze(lbl_i, axis=ax)
+        valid = lbl_i != ignore_index
+        safe = jnp.where(valid, lbl_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)
+        loss = -jnp.squeeze(picked, axis=ax)
+        if maybe_w:
+            w = jnp.take(maybe_w[0], safe, axis=0)
+            loss = loss * w
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, w, 0.0))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        else:
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(loss.dtype))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op("cross_entropy", _primal, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # reference returns loss with a trailing singleton dim on hard labels
+    if not soft_label:
+        from ...ops.manipulation import unsqueeze
+
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return op(
+        "mse_loss",
+        lambda a, b: _reduce(jnp.square(a - b), reduction),
+        [input, label],
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return op(
+        "l1_loss",
+        lambda a, b: _reduce(jnp.abs(a - b), reduction),
+        [input, label],
+    )
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def _primal(logp, lbl, *maybe_w):
+        lbl_i = lbl.astype(jnp.int32)
+        valid = lbl_i != ignore_index
+        safe = jnp.where(valid, lbl_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        w = jnp.take(maybe_w[0], safe, axis=0) if maybe_w else jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op("nll_loss", _primal, args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _primal(p, l, *maybe_w):
+        p_c = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(l * jnp.log(p_c) + (1 - l) * jnp.log1p(-p_c))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op("binary_cross_entropy", _primal, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def _primal(z, l, *extras):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extras[i]; i += 1
+        if pos_weight is not None:
+            pw = extras[i]; i += 1
+        if pw is None:
+            # numerically-stable: max(z,0) - z*l + log(1+exp(-|z|))
+            base = jnp.maximum(z, 0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        else:
+            base = -(pw * l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+
+    args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+    return op("bce_with_logits", _primal, args)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _primal(logp, tgt):
+        loss = tgt * (jnp.log(jnp.maximum(tgt, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return op("kl_div", _primal, [input, label])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _primal(a, b):
+        diff = jnp.abs(a - b)
+        loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return op("smooth_l1_loss", _primal, [input, label])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return op(
+        "margin_ranking_loss",
+        lambda a, b, l: _reduce(jnp.maximum(-l * (a - b) + margin, 0.0), reduction),
+        [input, other, label],
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return op(
+        "hinge_embedding_loss",
+        lambda a, l: _reduce(
+            jnp.where(l == 1, a, jnp.maximum(margin - a, 0.0)), reduction
+        ),
+        [input, label],
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def _primal(a, b, l):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+
+    return op("cosine_embedding_loss", _primal, [input1, input2, label])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _primal(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), axis=-1), 1 / p)
+        if swap:
+            dn2 = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return op("triplet_margin_loss", _primal, [input, positive, negative])
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        from ...ops.math import minimum
+
+        dn = minimum(dn, dn2)
+    from ...ops.math import maximum as _max
+    from ...ops import creation
+
+    diff = dp - dn + margin
+    zero = creation.zeros_like(diff)
+    loss = _max(diff, zero)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return op(
+        "log_loss",
+        lambda p, l: -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(1 - p + epsilon),
+        [input, label],
+    )
+
+
+def square_error_cost(input, label):
+    return op("square_error_cost", lambda a, b: jnp.square(a - b), [input, label])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _primal(z, l, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * l + (1 - p) * (1 - l)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return op("sigmoid_focal_loss", _primal, args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def _primal(p, l):
+        l_oh = jax.nn.one_hot(l.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        red_axes = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * l_oh, axis=red_axes)
+        union = jnp.sum(p, axis=red_axes) + jnp.sum(l_oh, axis=red_axes)
+        dice = (2 * inter + epsilon) / (union + epsilon)
+        return jnp.mean(1 - dice)
+
+    return op("dice_loss", _primal, [input, label])
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return op(
+        "soft_margin_loss",
+        lambda a, l: _reduce(jnp.log1p(jnp.exp(-l * a)), reduction),
+        [input, label],
+    )
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def _primal(z, l, *maybe_w):
+        loss = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op("multi_label_soft_margin_loss", _primal, args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def _primal(a, l):
+        if log_input:
+            loss = jnp.exp(a) - l * a
+        else:
+            loss = a - l * jnp.log(a + epsilon)
+        if full:
+            stirling = l * jnp.log(l + epsilon) - l + 0.5 * jnp.log(2 * jnp.pi * (l + epsilon))
+            loss = loss + jnp.where(l > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return op("poisson_nll_loss", _primal, [input, label])
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def _primal(mu, l, var):
+        var_c = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var_c) + jnp.square(l - mu) / var_c)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, dtype=loss.dtype))
+        return _reduce(loss, reduction)
+
+    return op("gaussian_nll_loss", _primal, [input, label, variance])
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard dynamic program in log space (lax.scan over time).
+
+    Reference: warpctc op; here a pure-XLA forward with jax.vjp gradient.
+    log_probs: [T, B, C] (paddle layout: max_logit_length, batch, classes).
+    """
+
+    def _primal(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        lab = lab.astype(jnp.int32)
+        S = lab.shape[1]
+        ext_len = 2 * S + 1
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, ext_len), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = jnp.asarray(-1e30, dtype=lp.dtype)
+
+        def get_probs(t):
+            # [B, ext_len] log prob of each extended symbol at time t
+            return jnp.take_along_axis(lp[t], ext, axis=1)
+
+        alpha0 = jnp.full((B, ext_len), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], lab[:, :1], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first_lab, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            new_alpha = merged + get_probs(t)
+            # freeze past each sequence's input length
+            active = (t < in_len)[:, None]
+            new_alpha = jnp.where(active, new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end1 = jnp.take_along_axis(alpha, (2 * lab_len)[:, None], axis=1)[:, 0]
+        end2 = jnp.take_along_axis(
+            alpha, jnp.maximum(2 * lab_len - 1, 0)[:, None], axis=1
+        )[:, 0]
+        ll = jnp.logaddexp(end1, jnp.where(lab_len > 0, end2, neg_inf))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return op("ctc_loss", _primal, [log_probs, labels, input_lengths, label_lengths])

@@ -1,0 +1,200 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm's running-stat update mutates the mean/variance tensors through the
+trace-aware ``_set_data`` path, so a jitted train step carries the running
+stats as program state (the reference keeps them as persistable vars).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import op, nondiff
+from ...core.tensor import Tensor
+
+__all__ = [
+    "batch_norm", "layer_norm", "instance_norm", "group_norm", "normalize",
+    "local_response_norm", "rms_norm",
+]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    use_batch_stats = training and not use_global_stats
+
+    def _shape_for(a, v):
+        s = [1] * a.ndim
+        s[-1 if channel_last else 1] = v.shape[0]
+        return v.reshape(s)
+
+    if use_batch_stats:
+        # batch statistics path; running stats updated outside the diff op
+        def _primal(a, *params):
+            axes = tuple(i for i in range(a.ndim) if i != (a.ndim - 1 if channel_last else 1))
+            mean = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+            out = (a - _shape_for(a, mean)) * jax.lax.rsqrt(_shape_for(a, var) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * _shape_for(a, params[i]); i += 1
+            if bias is not None:
+                out = out + _shape_for(a, params[i]); i += 1
+            return out
+
+        args = [x] + [p for p in (weight, bias) if p is not None]
+        out = op("batch_norm", _primal, args)
+        # update running stats (non-diff, trace-aware in-place writes)
+        xv = x._value()
+        axes = tuple(i for i in range(xv.ndim) if i != (xv.ndim - 1 if channel_last else 1))
+        bm = jnp.mean(xv, axis=axes)
+        bv = jnp.var(xv, axis=axes)
+        if running_mean is not None:
+            running_mean._set_data(
+                running_mean._value() * momentum + bm.astype(running_mean._value().dtype) * (1 - momentum)
+            )
+        if running_var is not None:
+            running_var._set_data(
+                running_var._value() * momentum + bv.astype(running_var._value().dtype) * (1 - momentum)
+            )
+        return out
+
+    def _primal(a, m, v, *params):
+        out = (a - _shape_for(a, m)) * jax.lax.rsqrt(_shape_for(a, v) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * _shape_for(a, params[i]); i += 1
+        if bias is not None:
+            out = out + _shape_for(a, params[i]); i += 1
+        return out
+
+    args = [x, running_mean, running_var] + [p for p in (weight, bias) if p is not None]
+    return op("batch_norm", _primal, args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+
+    def _primal(a, *params):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * params[i]; i += 1
+        if bias is not None:
+            out = out + params[i]; i += 1
+        return out
+
+    args = [x] + [p for p in (weight, bias) if p is not None]
+    return op("layer_norm", _primal, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (beyond-parity op for Llama-family models)."""
+
+    def _primal(a, *params):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if params:
+            out = out * params[0]
+        return out
+
+    args = [x] + ([weight] if weight is not None else [])
+    return op("rms_norm", _primal, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _primal(a, *params):
+        if channel_last:
+            axes = tuple(range(1, a.ndim - 1))
+            ch_axis = a.ndim - 1
+        else:
+            axes = tuple(range(2, a.ndim))
+            ch_axis = 1
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * a.ndim
+        i = 0
+        if weight is not None:
+            shape[ch_axis] = params[i].shape[0]
+            out = out * params[i].reshape(shape); i += 1
+        if bias is not None:
+            shape[ch_axis] = params[i].shape[0]
+            out = out + params[i].reshape(shape); i += 1
+        return out
+
+    args = [x] + [p for p in (weight, bias) if p is not None]
+    return op("instance_norm", _primal, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _primal(a, *params):
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        g = num_groups
+        grouped = a_t.reshape((n, g, c // g) + a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_t.shape)
+        shape = [1] * out.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * params[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + params[i].reshape(shape); i += 1
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x] + [p for p in (weight, bias) if p is not None]
+    return op("group_norm", _primal, args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _primal(a):
+        nrm = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return op("normalize", _primal, [x])
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _primal(a):
+        ch_axis = a.ndim - 1 if channel_last else 1
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[ch_axis] = size
+        summed = jax.lax.reduce_window(
+            padded, 0.0, jax.lax.add, tuple(window), (1,) * a.ndim,
+            [(0, 0)] * a.ndim
+        )
+        div = jnp.power(k + alpha * summed, beta)
+        return a / div
+
+    return op("local_response_norm", _primal, [x])

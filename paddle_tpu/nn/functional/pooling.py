@@ -1,0 +1,156 @@
+"""Pooling functionals over lax.reduce_window.
+
+Reference parity: python/paddle/nn/functional/pooling.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._helpers import op
+from .conv import _ntuple, _resolve_padding
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _window(nd, kernel, stride, channel_last):
+    k = _ntuple(kernel, nd)
+    s = _ntuple(stride if stride is not None else kernel, nd)
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    return dims, strides
+
+
+def _pads(nd, padding, channel_last, ceil_mode=False):
+    p = _resolve_padding(padding, nd)
+    if isinstance(p, str):
+        return p
+    if channel_last:
+        return [(0, 0)] + list(p) + [(0, 0)]
+    return [(0, 0), (0, 0)] + list(p)
+
+
+def _pool(name, x, nd, kernel, stride, padding, mode, ceil_mode, exclusive,
+          data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dims, strides = _window(nd, kernel, stride, channel_last)
+    pads = _pads(nd, padding, channel_last)
+
+    def _primal(a):
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, init, lax.max, dims, strides, pads)
+        # avg
+        summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pads)
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+            return summed / counts
+        return summed / float(np.prod([d for d in dims if d > 1] or [1]))
+
+    return op(name, _primal, [x])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool("avg_pool1d", x, 1, kernel_size, stride, padding, "avg",
+                 ceil_mode, exclusive, "NCW")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg_pool2d", x, 2, kernel_size, stride, padding, "avg",
+                 ceil_mode, exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg_pool3d", x, 3, kernel_size, stride, padding, "avg",
+                 ceil_mode, exclusive, data_format)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool("max_pool1d", x, 1, kernel_size, stride, padding, "max",
+                 ceil_mode, True, "NCW")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool("max_pool2d", x, 2, kernel_size, stride, padding, "max",
+                 ceil_mode, True, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool("max_pool3d", x, 3, kernel_size, stride, padding, "max",
+                 ceil_mode, True, data_format)
+
+
+def _adaptive(name, x, nd, output_size, mode, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    out_sizes = _ntuple(output_size, nd)
+
+    def _primal(a):
+        spatial_axes = list(range(1, 1 + nd)) if channel_last else list(range(2, 2 + nd))
+        out = a
+        # adaptive pooling = per-axis segment reduce; with divisible sizes this
+        # is an exact reshape+reduce (the common case on TPU); fall back to
+        # interpolation-window gather otherwise.
+        for ax, osz in zip(spatial_axes, out_sizes):
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                k = isz // osz
+                new_shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1 :]
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=ax + 1) if mode == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                starts = (np.arange(osz) * isz) // osz
+                ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+                slices = []
+                for s, e in zip(starts, ends):
+                    seg = lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" \
+                        else jnp.mean(seg, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return op(name, _primal, [x])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive("adaptive_avg_pool1d", x, 1, output_size, "avg", "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive("adaptive_avg_pool2d", x, 2, output_size, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive("adaptive_avg_pool3d", x, 3, output_size, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive("adaptive_max_pool1d", x, 1, output_size, "max", "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive("adaptive_max_pool2d", x, 2, output_size, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive("adaptive_max_pool3d", x, 3, output_size, "max", "NCDHW")

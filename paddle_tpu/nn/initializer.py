@@ -1,0 +1,191 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/*,
+python/paddle/fluid/initializer.py).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from the
+framework RNG (core.rng), so global seeding reproduces the reference's
+determinism contract.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as rng_mod
+from ..core import dtype as dtype_mod
+
+
+def _fan_in_out(shape):
+    shape = list(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: [out_c, in_c, *spatial] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype_mod.convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        k = rng_mod.next_key()
+        return jax.random.uniform(
+            k, shape, dtype=jnp.float32, minval=self.low, maxval=self.high
+        ).astype(dtype_mod.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = rng_mod.next_key()
+        return (
+            jax.random.normal(k, shape, dtype=jnp.float32) * self.std + self.mean
+        ).astype(dtype_mod.convert_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = rng_mod.next_key()
+        return (
+            jax.random.truncated_normal(k, -2.0, 2.0, shape, dtype=jnp.float32)
+            * self.std
+            + self.mean
+        ).astype(dtype_mod.convert_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = rng_mod.next_key()
+        return jax.random.uniform(
+            k, shape, dtype=jnp.float32, minval=-limit, maxval=limit
+        ).astype(dtype_mod.convert_dtype(dtype))
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = rng_mod.next_key()
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * std).astype(
+            dtype_mod.convert_dtype(dtype)
+        )
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "relu":
+            return math.sqrt(2.0)
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope**2))
+        return 1.0
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        k = rng_mod.next_key()
+        return jax.random.uniform(
+            k, shape, dtype=jnp.float32, minval=-limit, maxval=limit
+        ).astype(dtype_mod.convert_dtype(dtype))
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self._gain() / math.sqrt(fi)
+        k = rng_mod.next_key()
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * std).astype(
+            dtype_mod.convert_dtype(dtype)
+        )
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = jnp.asarray(np.asarray(self.value), dtype=dtype_mod.convert_dtype(dtype))
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        k = rng_mod.next_key()
+        return (jax.nn.initializers.orthogonal(self.gain)(k, tuple(shape), jnp.float32)).astype(
+            dtype_mod.convert_dtype(dtype)
+        )
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        arr = np.zeros(shape, dtype=np.float32)
+        out_c, in_c = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(out_c, in_c * self.groups)):
+            idx = (i, i % in_c, *centers)
+            arr[idx] = 1.0
+        return jnp.asarray(arr, dtype=dtype_mod.convert_dtype(dtype))
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a**2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0

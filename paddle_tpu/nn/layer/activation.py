@@ -1,0 +1,74 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from ..layer_base import Layer
+from .. import initializer as I
+from .. import functional as F
+
+
+def _simple(fname, cls_name, **fixed):
+    fn = getattr(F, fname)
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._args = args
+            self._kwargs = {**fixed, **kwargs}
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = cls_name
+    _Act.__qualname__ = cls_name
+    return _Act
+
+
+CELU = _simple("celu", "CELU")
+ELU = _simple("elu", "ELU")
+GELU = _simple("gelu", "GELU")
+Hardshrink = _simple("hardshrink", "Hardshrink")
+Hardsigmoid = _simple("hardsigmoid", "Hardsigmoid")
+Hardswish = _simple("hardswish", "Hardswish")
+Hardtanh = _simple("hardtanh", "Hardtanh")
+LeakyReLU = _simple("leaky_relu", "LeakyReLU")
+LogSigmoid = _simple("log_sigmoid", "LogSigmoid")
+LogSoftmax = _simple("log_softmax", "LogSoftmax")
+Mish = _simple("mish", "Mish")
+ReLU = _simple("relu", "ReLU")
+ReLU6 = _simple("relu6", "ReLU6")
+SELU = _simple("selu", "SELU")
+Sigmoid = _simple("sigmoid", "Sigmoid")
+Silu = _simple("silu", "Silu")
+Softmax = _simple("softmax", "Softmax")
+Softplus = _simple("softplus", "Softplus")
+Softshrink = _simple("softshrink", "Softshrink")
+Softsign = _simple("softsign", "Softsign")
+Swish = _simple("swish", "Swish")
+Tanh = _simple("tanh", "Tanh")
+Tanhshrink = _simple("tanhshrink", "Tanhshrink")
+ThresholdedReLU = _simple("thresholded_relu", "ThresholdedReLU")
+Maxout = _simple("maxout", "Maxout")
+GLU = _simple("glu", "GLU")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init),
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

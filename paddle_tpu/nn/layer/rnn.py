@@ -1,0 +1,372 @@
+"""Recurrent layers over lax.scan (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is a single ``lax.scan`` in the op primal, so
+the whole unrolled RNN compiles to one XLA while-loop with fused cell math —
+replacing the reference's per-step cudnn/JIT-gen kernels
+(operators/jit, cudnn_lstm).  Weight layout matches paddle:
+weight_ih [hidden*gates, input], weight_hh [hidden*gates, hidden],
+gate order i,f,c,o for LSTM and r,z,c for GRU (phi/kernels/cpu/rnn_kernel.cc).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import op
+from ...core.tensor import Tensor
+from ..layer_base import Layer
+from .. import initializer as I
+from .. import functional as F
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops import creation
+
+        batch = batch_ref.shape[batch_dim_idx]
+        st_shape = [batch, self.hidden_size]
+        if getattr(self, "state_count", 1) == 1:
+            return creation.full(st_shape, init_value, dtype or "float32")
+        return tuple(
+            creation.full(st_shape, init_value, dtype or "float32")
+            for _ in range(self.state_count)
+        )
+
+
+def _cell_params(layer, input_size, hidden_size, gates, weight_ih_attr,
+                 weight_hh_attr, bias_ih_attr, bias_hh_attr):
+    std = 1.0 / math.sqrt(hidden_size)
+    layer.weight_ih = layer.create_parameter(
+        [gates * hidden_size, input_size], attr=weight_ih_attr,
+        default_initializer=I.Uniform(-std, std))
+    layer.weight_hh = layer.create_parameter(
+        [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+        default_initializer=I.Uniform(-std, std))
+    if bias_ih_attr is not False:
+        layer.bias_ih = layer.create_parameter(
+            [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+    else:
+        layer.bias_ih = None
+    if bias_hh_attr is not False:
+        layer.bias_hh = layer.create_parameter(
+            [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+    else:
+        layer.bias_hh = None
+
+
+def _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh, hidden_size):
+    z = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        z = z + b_ih
+    if b_hh is not None:
+        z = z + b_hh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh, hidden_size):
+    gi = x @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+    gh = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    c = jnp.tanh(ic + r * hc)
+    return (1 - z) * c + z * h
+
+
+def _simple_step(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    z = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        z = z + b_ih
+    if b_hh is not None:
+        z = z + b_hh
+    return jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+
+
+class SimpleRNNCell(RNNCellBase):
+    state_count = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self.activation
+
+        def _primal(x, h, *ws):
+            w_ih, w_hh = ws[0], ws[1]
+            b_ih = ws[2] if self.bias_ih is not None else None
+            b_hh = ws[3 if self.bias_ih is not None else 2] if self.bias_hh is not None else None
+            return _simple_step(x, h, w_ih, w_hh, b_ih, b_hh, act)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        out = op("simple_rnn_cell", _primal, args)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    state_count = 2
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        hs = self.hidden_size
+
+        def _primal(x, h0, c0, *ws):
+            w_ih, w_hh = ws[0], ws[1]
+            rest = list(ws[2:])
+            b_ih = rest.pop(0) if self.bias_ih is not None else None
+            b_hh = rest.pop(0) if self.bias_hh is not None else None
+            return _lstm_step(x, h0, c0, w_ih, w_hh, b_ih, b_hh, hs)
+
+        args = [inputs, h, c, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h_new, c_new = op("lstm_cell", _primal, args, n_outs=2)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    state_count = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        hs = self.hidden_size
+
+        def _primal(x, h0, *ws):
+            w_ih, w_hh = ws[0], ws[1]
+            rest = list(ws[2:])
+            b_ih = rest.pop(0) if self.bias_ih is not None else None
+            b_hh = rest.pop(0) if self.bias_hh is not None else None
+            return _gru_step(x, h0, w_ih, w_hh, b_ih, b_hh, hs)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        out = op("gru_cell", _primal, args)
+        return out, out
+
+
+class RNN(Layer):
+    """Wrap a cell into a time-looped layer via lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = "lstm" if isinstance(self.cell, LSTMCell) else (
+            "gru" if isinstance(self.cell, GRUCell) else "simple")
+        return _run_rnn_layer(
+            inputs, initial_states, self.cell, mode, self.is_reverse,
+            self.time_major)
+
+
+def _run_rnn_layer(inputs, initial_states, cell, mode, is_reverse, time_major):
+    hs = cell.hidden_size
+    act = getattr(cell, "activation", "tanh")
+    has_bih = cell.bias_ih is not None
+    has_bhh = cell.bias_hh is not None
+    two_state = mode == "lstm"
+
+    if initial_states is None:
+        batch_axis = 1 if time_major else 0
+        initial_states = cell.get_initial_states(inputs, batch_dim_idx=batch_axis)
+    states = list(initial_states) if two_state else [initial_states]
+
+    def _primal(x, *rest):
+        rest = list(rest)
+        sts = [rest.pop(0) for _ in range(2 if two_state else 1)]
+        w_ih, w_hh = rest.pop(0), rest.pop(0)
+        b_ih = rest.pop(0) if has_bih else None
+        b_hh = rest.pop(0) if has_bhh else None
+        xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, I]
+        if is_reverse:
+            xs = jnp.flip(xs, axis=0)
+
+        def step(carry, xt):
+            if mode == "lstm":
+                h, c = carry
+                h2, c2 = _lstm_step(xt, h, c, w_ih, w_hh, b_ih, b_hh, hs)
+                return (h2, c2), h2
+            h = carry[0]
+            if mode == "gru":
+                h2 = _gru_step(xt, h, w_ih, w_hh, b_ih, b_hh, hs)
+            else:
+                h2 = _simple_step(xt, h, w_ih, w_hh, b_ih, b_hh, act)
+            return (h2,), h2
+
+        carry, ys = jax.lax.scan(step, tuple(sts), xs)
+        if is_reverse:
+            ys = jnp.flip(ys, axis=0)
+        out = ys if time_major else jnp.swapaxes(ys, 0, 1)
+        return (out, *carry)
+
+    args = [inputs, *states, cell.weight_ih, cell.weight_hh]
+    args += [b for b in (cell.bias_ih, cell.bias_hh) if b is not None]
+    outs = op(f"rnn_{mode}", _primal, args, n_outs=3 if two_state else 2)
+    if two_state:
+        return outs[0], (outs[1], outs[2])
+    return outs[0], outs[1]
+
+
+class _MultiLayerRNN(Layer):
+    _cell_cls = None
+    _mode = "simple"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, **cell_kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        from .container import LayerList
+
+        self.fw_cells = LayerList()
+        self.bw_cells = LayerList() if self.bidirect else None
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * (2 if self.bidirect else 1)
+            self.fw_cells.append(self._cell_cls(
+                in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                bias_hh_attr=bias_hh_attr, **cell_kwargs))
+            if self.bidirect:
+                self.bw_cells.append(self._cell_cls(
+                    in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                    weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                    bias_hh_attr=bias_hh_attr, **cell_kwargs))
+
+    @property
+    def state_components(self):
+        return 2 if self._mode == "lstm" else 1
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat, stack
+
+        two_state = self._mode == "lstm"
+        n_dirs = 2 if self.bidirect else 1
+        out = inputs
+        final_h, final_c = [], []
+        for i in range(self.num_layers):
+            init_fw = init_bw = None
+            if initial_states is not None:
+                if two_state:
+                    h0, c0 = initial_states
+                    init_fw = (h0[i * n_dirs], c0[i * n_dirs])
+                    if self.bidirect:
+                        init_bw = (h0[i * n_dirs + 1], c0[i * n_dirs + 1])
+                else:
+                    init_fw = initial_states[i * n_dirs]
+                    if self.bidirect:
+                        init_bw = initial_states[i * n_dirs + 1]
+            fw_out, fw_state = _run_rnn_layer(
+                out, init_fw, self.fw_cells[i], self._mode, False,
+                self.time_major)
+            if self.bidirect:
+                bw_out, bw_state = _run_rnn_layer(
+                    out, init_bw, self.bw_cells[i], self._mode, True,
+                    self.time_major)
+                out = concat([fw_out, bw_out], axis=-1)
+            else:
+                out = fw_out
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                out = F.dropout(out, p=self.dropout, training=self.training)
+            if two_state:
+                final_h.append(fw_state[0]); final_c.append(fw_state[1])
+                if self.bidirect:
+                    final_h.append(bw_state[0]); final_c.append(bw_state[1])
+            else:
+                final_h.append(fw_state)
+                if self.bidirect:
+                    final_h.append(bw_state)
+        if two_state:
+            return out, (stack(final_h, axis=0), stack(final_c, axis=0))
+        return out, stack(final_h, axis=0)
+
+
+class SimpleRNN(_MultiLayerRNN):
+    _cell_cls = SimpleRNNCell
+    _mode = "simple"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class LSTM(_MultiLayerRNN):
+    _cell_cls = LSTMCell
+    _mode = "lstm"
+
+
+class GRU(_MultiLayerRNN):
+    _cell_cls = GRUCell
+    _mode = "gru"
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper around two cells (reference: nn/layer/rnn.py BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+
+        mode_of = lambda c: "lstm" if isinstance(c, LSTMCell) else (
+            "gru" if isinstance(c, GRUCell) else "simple")
+        init_fw = init_bw = None
+        if initial_states is not None:
+            init_fw, init_bw = initial_states
+        fw_out, fw_state = _run_rnn_layer(
+            inputs, init_fw, self.cell_fw, mode_of(self.cell_fw), False,
+            self.time_major)
+        bw_out, bw_state = _run_rnn_layer(
+            inputs, init_bw, self.cell_bw, mode_of(self.cell_bw), True,
+            self.time_major)
+        return concat([fw_out, bw_out], axis=-1), (fw_state, bw_state)

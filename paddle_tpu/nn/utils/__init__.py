@@ -1,0 +1,98 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..layer_base import Layer, Parameter
+from ..clip import clip_grad_norm_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    arr = vec._value()
+    for p in parameters:
+        n = p.size
+        p._set_data(arr[offset:offset + n].reshape(p.shape).astype(p._value().dtype))
+        offset += n
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """Reparameterize ``layer.weight`` as g * v/|v| (reference:
+    nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    arr = w._value()
+    axes = tuple(i for i in range(arr.ndim) if i != dim)
+    g0 = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=False))
+    layer.add_parameter(name + "_g", Parameter(g0))
+    layer.add_parameter(name + "_v", Parameter(arr))
+    del layer._parameters[name]
+
+    def _pre_hook(module, inputs):
+        from ...ops._helpers import op
+
+        g = module._parameters[name + "_g"]
+        v = module._parameters[name + "_v"]
+
+        def _primal(gv, vv):
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            nrm = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+            return vv / nrm * gv.reshape(shape)
+
+        w_t = op("weight_norm", _primal, [g, v])
+        object.__setattr__(module, "_wn_cache_" + name, w_t)
+        module.__dict__[name] = w_t
+        return None
+
+    handle = layer.register_forward_pre_hook(_pre_hook)
+    layer._weight_norm_handle = handle
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    arr = v._value()
+    dim_candidates = [i for i in range(arr.ndim)]
+    # recompute with stored g along its dim (norm over all other axes)
+    # fall back to dim=0 convention
+    axes = tuple(i for i in range(arr.ndim) if i != 0)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=True))
+    shape = [1] * arr.ndim
+    shape[0] = -1
+    w = arr / nrm * g._value().reshape(shape)
+    layer.add_parameter(name, Parameter(w))
+    if hasattr(layer, "_weight_norm_handle"):
+        layer._weight_norm_handle.remove()
+    layer.__dict__.pop(name, None)
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization to a layer's weight via a pre-hook."""
+    from ..layer.norm import SpectralNorm
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(w.shape, axis=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(name + "_orig", orig)
+
+    def _pre_hook(module, inputs):
+        w_t = sn(module._parameters[name + "_orig"])
+        module.__dict__[name] = w_t
+        return None
+
+    layer.register_forward_pre_hook(_pre_hook)
+    return layer

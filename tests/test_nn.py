@@ -1,0 +1,384 @@
+"""nn.Layer / layers / functional tests.
+
+Modeled on the reference's OpTest+layer tests (SURVEY.md §4): outputs are
+checked against numpy/torch-free closed forms, gradients against
+finite differences where cheap.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr, dtype=np.float32), stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_registration_and_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        sd = net.state_dict()
+        assert set(sd.keys()) == set(names)
+
+        net2 = Net()
+        net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        x = t(np.ones((2, 4)))
+        np.testing.assert_allclose(net[1](x).numpy(), x.numpy())
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h1 = lin.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+        h2 = lin.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+        lin(t(np.ones((1, 2))))
+        assert calls == ["pre", "post"]
+        h1.remove(); h2.remove()
+        lin(t(np.ones((1, 2))))
+        assert calls == ["pre", "post"]
+
+    def test_apply_and_to_dtype(self):
+        net = nn.Sequential(nn.Linear(3, 3), nn.Linear(3, 3))
+        net.to(dtype="bfloat16")
+        assert str(net[0].weight.dtype) in ("bfloat16", "bfloat16")
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        bufs = dict(bn.named_buffers())
+        assert "_mean" in bufs and "_variance" in bufs
+
+
+class TestLinearConv:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(5, 3)
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        got = lin(t(x)).numpy()
+        want = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_conv2d_shape_and_grad(self):
+        conv = nn.Conv2D(3, 6, 3, stride=2, padding=1)
+        x = t(np.random.randn(2, 3, 8, 8), sg=False)
+        y = conv(x)
+        assert y.shape == [2, 6, 4, 4]
+        y.sum().backward()
+        assert x.grad.shape == [2, 3, 8, 8]
+        assert conv.weight.grad.shape == [6, 3, 3, 3]
+
+    def test_conv2d_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        y = conv(t(np.random.randn(1, 4, 5, 5)))
+        assert y.shape == [1, 8, 5, 5]
+
+    def test_conv2d_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        y = deconv(t(np.random.randn(1, 4, 5, 5)))
+        assert y.shape == [1, 2, 9, 9]
+
+    def test_conv1d(self):
+        conv = nn.Conv1D(2, 4, 3, padding=1)
+        y = conv(t(np.random.randn(2, 2, 10)))
+        assert y.shape == [2, 4, 10]
+
+
+class TestNorm:
+    def test_batchnorm_train_normalizes(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.randn(8, 3, 4, 4) * 5 + 2)
+        y = bn(x).numpy()
+        np.testing.assert_allclose(y.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+        np.testing.assert_allclose(y.std(axis=(0, 2, 3)), 1, atol=1e-2)
+
+    def test_batchnorm_running_stats_update(self):
+        bn = nn.BatchNorm2D(3, momentum=0.0)  # running = batch stats
+        x = np.random.randn(16, 3, 4, 4).astype(np.float32) * 3 + 1
+        bn(t(x))
+        np.testing.assert_allclose(bn._mean.numpy(), x.mean(axis=(0, 2, 3)),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(2)
+        bn.eval()
+        x = t(np.random.randn(4, 2, 3, 3))
+        y = bn(x).numpy()
+        np.testing.assert_allclose(y, x.numpy() / np.sqrt(1 + 1e-5), rtol=1e-4)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = t(np.random.randn(4, 8) * 3 + 5)
+        y = ln(x).numpy()
+        np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        y = gn(t(np.random.randn(2, 4, 3, 3)))
+        assert y.shape == [2, 4, 3, 3]
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = np.random.randn(2, 8).astype(np.float32)
+        y = rn(t(x)).numpy()
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y, want, rtol=1e-4)
+
+
+class TestPooling:
+    def test_maxpool(self):
+        x = t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        y = F.max_pool2d(x, 2, 2).numpy()
+        np.testing.assert_allclose(y[0, 0], [[5, 7], [13, 15]])
+
+    def test_avgpool(self):
+        x = t(np.ones((1, 1, 4, 4)))
+        y = F.avg_pool2d(x, 2, 2).numpy()
+        np.testing.assert_allclose(y, np.ones((1, 1, 2, 2)))
+
+    def test_adaptive_avg_pool(self):
+        x = t(np.random.randn(2, 3, 8, 8))
+        y = F.adaptive_avg_pool2d(x, 1)
+        assert y.shape == [2, 3, 1, 1]
+        np.testing.assert_allclose(
+            y.numpy()[..., 0, 0], x.numpy().mean(axis=(2, 3)), rtol=1e-5
+        )
+
+
+class TestActivations:
+    @pytest.mark.parametrize("fname,ref", [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("relu6", lambda x: np.clip(x, 0, 6)),
+        ("hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6),
+        ("softsign", lambda x: x / (1 + np.abs(x))),
+    ])
+    def test_matches_numpy(self, fname, ref):
+        x = np.linspace(-8, 8, 23).astype(np.float32)
+        got = getattr(F, fname)(t(x)).numpy()
+        np.testing.assert_allclose(got, ref(x), rtol=1e-4, atol=1e-5)
+
+    def test_softmax_rows_sum_to_one(self):
+        y = F.softmax(t(np.random.randn(3, 7))).numpy()
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+
+    def test_prelu_layer(self):
+        pr = nn.PReLU(num_parameters=4)
+        y = pr(t(np.random.randn(2, 4, 3, 3)))
+        assert y.shape == [2, 4, 3, 3]
+
+
+class TestLosses:
+    def test_cross_entropy_hard(self):
+        logits = np.random.RandomState(1).randn(6, 5).astype(np.float32)
+        labels = np.array([0, 1, 2, 3, 4, 0])
+        got = float(F.cross_entropy(t(logits), paddle.to_tensor(labels)))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(6), labels]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 1, -100])
+        got = float(F.cross_entropy(t(logits), paddle.to_tensor(labels),
+                                    ignore_index=-100))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_soft(self):
+        logits = np.random.randn(3, 4).astype(np.float32)
+        soft = np.full((3, 4), 0.25, dtype=np.float32)
+        got = float(F.cross_entropy(t(logits), t(soft), soft_label=True))
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        want = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mse_and_l1(self):
+        a, b = np.random.randn(5).astype(np.float32), np.random.randn(5).astype(np.float32)
+        np.testing.assert_allclose(float(F.mse_loss(t(a), t(b))),
+                                   ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(F.l1_loss(t(a), t(b))),
+                                   np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        z = np.random.randn(8).astype(np.float32)
+        l = (np.random.rand(8) > 0.5).astype(np.float32)
+        got = float(F.binary_cross_entropy_with_logits(t(z), t(l)))
+        p = 1 / (1 + np.exp(-z))
+        want = -(l * np.log(p) + (1 - l) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_kl_div(self):
+        logp = np.log(np.array([[0.3, 0.7]], dtype=np.float32))
+        tgt = np.array([[0.5, 0.5]], dtype=np.float32)
+        got = float(F.kl_div(t(logp), t(tgt), reduction="sum"))
+        want = (tgt * (np.log(tgt) - logp)).sum()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_ctc_loss_simple(self):
+        # T=4, B=1, C=3; target "ab" (labels 1,2)
+        T, B, C = 4, 1, 3
+        rs = np.random.RandomState(0)
+        logits = rs.randn(T, B, C).astype(np.float32)
+        loss = F.ctc_loss(t(logits, sg=False), paddle.to_tensor(np.array([[1, 2]])),
+                          paddle.to_tensor(np.array([4])),
+                          paddle.to_tensor(np.array([2])), reduction="none")
+        # brute force: sum over all alignments of length 4 that collapse to [1,2]
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        total = -np.inf
+        import itertools
+
+        for path in itertools.product(range(C), repeat=T):
+            collapsed = []
+            prev = None
+            for s in path:
+                if s != prev and s != 0:
+                    collapsed.append(s)
+                prev = s
+            if collapsed == [1, 2]:
+                lp = sum(logp[i, 0, s] for i, s in enumerate(path))
+                total = np.logaddexp(total, lp)
+        np.testing.assert_allclose(float(loss), -total, rtol=1e-4)
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_scales(self):
+        paddle.seed(42)
+        x = t(np.ones((1000,)))
+        y = F.dropout(x, p=0.5, training=True).numpy()
+        assert np.isclose((y == 0).mean(), 0.5, atol=0.1)
+        np.testing.assert_allclose(y[y != 0], 2.0)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        np.testing.assert_allclose(emb.weight.numpy()[0], np.zeros(4))
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = t(np.random.randn(3, 6, 4))
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 6, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+
+    def test_bidirect_gru(self):
+        gru = nn.GRU(4, 5, direction="bidirect")
+        out, h = gru(t(np.random.randn(2, 7, 4)))
+        assert out.shape == [2, 7, 10]
+        assert h.shape == [2, 2, 5]
+
+    def test_lstm_cell_matches_manual(self):
+        cell = nn.LSTMCell(3, 4)
+        x = np.random.randn(2, 3).astype(np.float32)
+        h0 = np.zeros((2, 4), dtype=np.float32)
+        c0 = np.zeros((2, 4), dtype=np.float32)
+        out, (h, c) = cell(t(x), (t(h0), t(c0)))
+        z = x @ cell.weight_ih.numpy().T + h0 @ cell.weight_hh.numpy().T \
+            + cell.bias_ih.numpy() + cell.bias_hh.numpy()
+        i, f, g, o = np.split(z, 4, axis=-1)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        c_ref = sig(f) * c0 + sig(i) * np.tanh(g)
+        h_ref = sig(o) * np.tanh(c_ref)
+        np.testing.assert_allclose(h.numpy(), h_ref, rtol=1e-4, atol=1e-5)
+
+    def test_rnn_gradients_flow(self):
+        lstm = nn.LSTM(3, 4)
+        x = t(np.random.randn(2, 5, 3), sg=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.fw_cells[0].weight_ih.grad is not None
+
+
+class TestTransformer:
+    def test_encoder_layer(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        layer.eval()
+        x = t(np.random.randn(2, 6, 16))
+        y = layer(x)
+        assert y.shape == [2, 6, 16]
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32,
+                               dropout=0.0)
+        model.eval()
+        src = t(np.random.randn(2, 5, 16))
+        tgt = t(np.random.randn(2, 3, 16))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_attention_causal_mask(self):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        mha.eval()
+        x = t(np.random.randn(1, 4, 8))
+        mask = nn.Transformer.generate_square_subsequent_mask(4)
+        y = mha(x, attn_mask=mask)
+        assert y.shape == [1, 4, 8]
+
+    def test_grad_through_attention(self):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        x = t(np.random.randn(2, 4, 8), sg=False)
+        mha(x).sum().backward()
+        assert x.grad is not None
+        assert mha.q_proj.weight.grad is not None
+
+
+class TestClip:
+    def test_clip_by_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        g1 = t(np.ones(4) * 3)
+        g2 = t(np.ones(4) * 4)
+        p1, p2 = nn.Parameter(np.zeros(4)), nn.Parameter(np.zeros(4))
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+    def test_clip_by_value(self):
+        clip = nn.ClipGradByValue(0.5)
+        p = nn.Parameter(np.zeros(3))
+        (_, g), = clip([(p, t(np.array([-2.0, 0.2, 2.0])))])
+        np.testing.assert_allclose(g.numpy(), [-0.5, 0.2, 0.5])
+
+
+class TestWeightNorm:
+    def test_weight_norm_roundtrip(self):
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, "weight", dim=0)
+        x = t(np.random.randn(2, 4))
+        y1 = lin(x).numpy()
+        np.testing.assert_allclose(
+            y1, x.numpy() @ w0 + lin.bias.numpy(), rtol=1e-4, atol=1e-5
+        )
