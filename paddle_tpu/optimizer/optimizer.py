@@ -1,0 +1,373 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:91).
+
+TPU-native design: parameter updates are pure-jax expressions applied through
+the trace-aware ``_set_data`` path, so ``opt.step()`` inside a ``to_static``
+train step compiles into the same XLA program as forward+backward (the
+reference reaches the same shape via fused adamw ops in ProgramDesc).
+Accumulator state lives in Tensors keyed by parameter name, mirroring the
+reference's accumulator scope vars.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from ..core import dtype as dtype_mod
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._name = name
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay = float(weight_decay)
+        elif weight_decay is None:
+            self._weight_decay = None
+        else:  # L2Decay-like object with a coeff
+            self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(
+                weight_decay, "coeff", 0.0)))
+        # name → {acc_name: Tensor}
+        self._accumulators: Dict[str, Dict[str, Tensor]] = {}
+        self._global_step = 0
+
+    # -- lr ----------------------------------------------------------------
+
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "set_lr is not allowed when learning rate is an LRScheduler; "
+                "use scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def _lr_array(self):
+        return jnp.asarray(self.get_lr(), dtype=jnp.float32)
+
+    # -- accumulators -------------------------------------------------------
+
+    def _param_key(self, p: Tensor) -> str:
+        return p.name or f"param_{id(p)}"
+
+    def _get_accumulator(self, name: str, p: Tensor, init=0.0,
+                         dtype=None) -> Tensor:
+        key = self._param_key(p)
+        accs = self._accumulators.setdefault(key, {})
+        if name not in accs:
+            dt = dtype or p._value().dtype
+            if dtype == "master" :
+                dt = jnp.float32
+            accs[name] = Tensor._wrap(
+                jnp.full(p.shape, init, dtype=dt), stop_gradient=True)
+        return accs[name]
+
+    # -- main entry points ---------------------------------------------------
+
+    def _collect_params_grads(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        out = []
+        for p in params:
+            if not getattr(p, "trainable", True):
+                continue
+            g = p.grad
+            if g is None:
+                continue
+            out.append((p, g))
+        return out
+
+    @no_grad()
+    def step(self):
+        params_grads = self._collect_params_grads()
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._global_step += 1
+        for p, g in params_grads:
+            garr = g._value() if isinstance(g, Tensor) else g
+            self._update_param(p, garr.astype(jnp.float32)
+                               if garr.dtype == jnp.bfloat16 else garr)
+
+    minimize_step = step
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def _apply(self, p: Tensor, new_value):
+        p._set_data(new_value.astype(p._value().dtype))
+
+    def _update_param(self, p: Tensor, g):
+        raise NotImplementedError
+
+    def _decayed_grad(self, p, g):
+        """L2 regularization folded into the gradient (reference: coupled
+        weight decay for SGD/Momentum family)."""
+        if self._weight_decay:
+            g = g + self._weight_decay * p._value().astype(g.dtype)
+        return g
+
+    # -- state dict ----------------------------------------------------------
+
+    def state_dict(self):
+        sd = {}
+        for pkey, accs in self._accumulators.items():
+            for aname, t in accs.items():
+                sd[f"{pkey}/{aname}"] = t
+        sd["@global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["@lr_scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        import numpy as np
+
+        for k, v in state_dict.items():
+            if k == "@global_step":
+                self._global_step = int(v)
+                continue
+            if k == "@lr_scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(v)
+                continue
+            pkey, aname = k.rsplit("/", 1)
+            arr = v._value() if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            self._accumulators.setdefault(pkey, {})[aname] = Tensor._wrap(arr)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, g):
+        g = self._decayed_grad(p, g)
+        lr = self._lr_array().astype(g.dtype)
+        self._apply(p, p._value() - lr * g)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, g):
+        g = self._decayed_grad(p, g)
+        lr = self._lr_array().astype(g.dtype)
+        vel = self._get_accumulator("velocity", p)
+        v_new = self._momentum * vel._value().astype(g.dtype) + g
+        vel._set_data(v_new.astype(vel._value().dtype))
+        if self._use_nesterov:
+            upd = g + self._momentum * v_new
+        else:
+            upd = v_new
+        self._apply(p, p._value() - lr * upd)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _adam_update(self, p, g, decoupled_wd=0.0):
+        lr = self._lr_array()
+        m = self._get_accumulator("moment1", p, dtype=jnp.float32)
+        v = self._get_accumulator("moment2", p, dtype=jnp.float32)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32)
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        m_new = self._beta1 * m._value() + (1 - self._beta1) * g32
+        v_new = self._beta2 * v._value() + (1 - self._beta2) * jnp.square(g32)
+        b1p_new = b1p._value() * self._beta1
+        b2p_new = b2p._value() * self._beta2
+        m._set_data(m_new)
+        v._set_data(v_new)
+        b1p._set_data(b1p_new)
+        b2p._set_data(b2p_new)
+        m_hat = m_new / (1.0 - b1p_new)
+        v_hat = v_new / (1.0 - b2p_new)
+        p32 = p._value().astype(jnp.float32)
+        if decoupled_wd:
+            p32 = p32 * (1.0 - lr * decoupled_wd)
+        self._apply(p, p32 - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon))
+
+    def _update_param(self, p, g):
+        g = self._decayed_grad(p, g)
+        self._adam_update(p, g)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py → fused adamw op)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd = float(weight_decay) if not hasattr(weight_decay, "_coeff") \
+            else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update_param(self, p, g):
+        wd = self._wd
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        self._adam_update(p, g, decoupled_wd=wd)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g):
+        g = self._decayed_grad(p, g)
+        lr = self._lr_array()
+        m = self._get_accumulator("moment", p, dtype=jnp.float32)
+        u = self._get_accumulator("inf_norm", p, dtype=jnp.float32)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        m_new = self._beta1 * m._value() + (1 - self._beta1) * g32
+        u_new = jnp.maximum(self._beta2 * u._value(), jnp.abs(g32))
+        b1p_new = b1p._value() * self._beta1
+        m._set_data(m_new); u._set_data(u_new); b1p._set_data(b1p_new)
+        self._apply(p, p._value().astype(jnp.float32)
+                    - lr / (1 - b1p_new) * m_new / (u_new + self._epsilon))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g):
+        g = self._decayed_grad(p, g)
+        lr = self._lr_array()
+        acc = self._get_accumulator("moment", p, init=self._init_acc,
+                                    dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        acc_new = acc._value() + jnp.square(g32)
+        acc._set_data(acc_new)
+        self._apply(p, p._value().astype(jnp.float32)
+                    - lr * g32 / (jnp.sqrt(acc_new) + self._epsilon))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g):
+        g = self._decayed_grad(p, g)
+        lr = self._lr_array()
+        ms = self._get_accumulator("mean_square", p, dtype=jnp.float32)
+        mom = self._get_accumulator("momentum", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        ms_new = self._rho * ms._value() + (1 - self._rho) * jnp.square(g32)
+        ms._set_data(ms_new)
+        denom = ms_new
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p, dtype=jnp.float32)
+            mg_new = self._rho * mg._value() + (1 - self._rho) * g32
+            mg._set_data(mg_new)
+            denom = ms_new - jnp.square(mg_new)
+        upd = self._momentum * mom._value() + lr * g32 / jnp.sqrt(denom + self._epsilon)
+        mom._set_data(upd)
+        self._apply(p, p._value().astype(jnp.float32) - upd)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_param(self, p, g):
+        g = self._decayed_grad(p, g)
+        lr = self._lr_array()
+        avg_sq_g = self._get_accumulator("avg_squared_grad", p, dtype=jnp.float32)
+        avg_sq_u = self._get_accumulator("avg_squared_update", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * avg_sq_g._value() + (1 - self._rho) * jnp.square(g32)
+        upd = -jnp.sqrt((avg_sq_u._value() + self._epsilon) /
+                        (asg + self._epsilon)) * g32
+        asu = self._rho * avg_sq_u._value() + (1 - self._rho) * jnp.square(upd)
+        avg_sq_g._set_data(asg)
+        avg_sq_u._set_data(asu)
+        self._apply(p, p._value().astype(jnp.float32) + lr * upd)
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference: optimizer/lamb.py; the
+    distributed_fused_lamb op family collapses to this math under jit)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g):
+        lr = self._lr_array()
+        m = self._get_accumulator("moment1", p, dtype=jnp.float32)
+        v = self._get_accumulator("moment2", p, dtype=jnp.float32)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32)
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        m_new = self._beta1 * m._value() + (1 - self._beta1) * g32
+        v_new = self._beta2 * v._value() + (1 - self._beta2) * jnp.square(g32)
+        b1p_new = b1p._value() * self._beta1
+        b2p_new = b2p._value() * self._beta2
+        m._set_data(m_new); v._set_data(v_new)
+        b1p._set_data(b1p_new); b2p._set_data(b2p_new)
+        m_hat = m_new / (1 - b1p_new)
+        v_hat = v_new / (1 - b2p_new)
+        p32 = p._value().astype(jnp.float32)
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + wd * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        self._apply(p, p32 - lr * trust * r)

@@ -1,0 +1,176 @@
+"""Optimizer & lr scheduler tests (reference test model: unittests
+test_adam_op.py / test_momentum_op.py numeric checks + scheduler curves)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def quad_problem(optimizer_fn, steps=50):
+    """Minimize ||Wx - y||^2; return final loss."""
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    optimizer = optimizer_fn(net.parameters())
+    xs = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    w_true = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+    x = paddle.to_tensor(xs)
+    y = paddle.to_tensor(xs @ w_true)  # realizable target
+    loss_val = None
+    for _ in range(steps):
+        out = net(x)
+        loss = ((out - y) * (out - y)).mean()
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        loss_val = float(loss)
+    return loss_val
+
+
+class TestOptimizersConverge:
+    def test_sgd(self):
+        assert quad_problem(lambda p: opt.SGD(0.1, parameters=p)) < 0.4
+
+    def test_momentum(self):
+        assert quad_problem(lambda p: opt.Momentum(0.05, 0.9, parameters=p)) < 0.1
+
+    def test_adam(self):
+        assert quad_problem(lambda p: opt.Adam(0.1, parameters=p)) < 0.05
+
+    def test_adamw(self):
+        assert quad_problem(lambda p: opt.AdamW(0.1, parameters=p)) < 0.1
+
+    def test_rmsprop(self):
+        assert quad_problem(lambda p: opt.RMSProp(0.01, parameters=p), 150) < 0.2
+
+    def test_adagrad(self):
+        assert quad_problem(lambda p: opt.Adagrad(0.5, parameters=p)) < 0.3
+
+    def test_lamb(self):
+        assert quad_problem(lambda p: opt.Lamb(0.05, parameters=p), 80) < 0.3
+
+
+class TestAdamNumerics:
+    def test_single_step_matches_reference_math(self):
+        w = nn.Parameter(np.array([1.0, 2.0], dtype=np.float32))
+        g = np.array([0.5, -0.3], dtype=np.float32)
+        w.grad = paddle.to_tensor(g)
+        o = opt.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                     parameters=[w])
+        o.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        m_hat = m / (1 - 0.9)
+        v_hat = v / (1 - 0.999)
+        want = np.array([1.0, 2.0]) - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-8)
+        np.testing.assert_allclose(w.numpy(), want, rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        w = nn.Parameter(np.array([1.0], dtype=np.float32))
+        w.grad = paddle.to_tensor(np.array([0.0], dtype=np.float32))
+        o = opt.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+        o.step()
+        # zero grad → only decay applies: w *= (1 - lr*wd)
+        np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.1 * 0.5)], rtol=1e-5)
+
+    def test_momentum_velocity(self):
+        w = nn.Parameter(np.array([0.0], dtype=np.float32))
+        o = opt.Momentum(learning_rate=1.0, momentum=0.5, parameters=[w])
+        for _ in range(2):
+            w.grad = paddle.to_tensor(np.array([1.0], dtype=np.float32))
+            o.step()
+            o.clear_grad()
+        # v1=1, w=-1; v2=0.5+1=1.5, w=-2.5
+        np.testing.assert_allclose(w.numpy(), [-2.5], rtol=1e-6)
+
+
+class TestOptimizerStateDict:
+    def test_roundtrip(self):
+        net = nn.Linear(3, 3)
+        o = opt.Adam(0.01, parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((2, 3), dtype=np.float32))
+        net(x).sum().backward()
+        o.step()
+        sd = o.state_dict()
+        o2 = opt.Adam(0.01, parameters=net.parameters())
+        o2.set_state_dict(sd)
+        key = [k for k in sd if k.endswith("/moment1")][0]
+        np.testing.assert_allclose(
+            o2._accumulators[key.rsplit("/", 1)[0]]["moment1"].numpy(),
+            sd[key].numpy())
+
+
+class TestGradClipIntegration:
+    def test_global_norm_clip_in_optimizer(self):
+        w = nn.Parameter(np.zeros(4, dtype=np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        o = opt.SGD(1.0, parameters=[w], grad_clip=clip)
+        w.grad = paddle.to_tensor(np.full(4, 10.0, dtype=np.float32))
+        o.step()
+        np.testing.assert_allclose(np.linalg.norm(w.numpy()), 1.0, rtol=1e-4)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_mod.StepDecay(1.0, step_size=2, gamma=0.1)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [1.0, 1.0, 0.1, 0.1, 0.01], rtol=1e-6)
+
+    def test_piecewise(self):
+        s = lr_mod.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1])
+        lrs = [s() for _ in range(5) if s.step() or True]
+        assert lrs[0] == 0.5 or True  # sequence checked below
+        s2 = lr_mod.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1])
+        vals = []
+        for _ in range(6):
+            vals.append(s2())
+            s2.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.1, 0.1])
+
+    def test_linear_warmup(self):
+        s = lr_mod.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(6):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[:5], [0.0, 0.025, 0.05, 0.075, 0.1],
+                                   rtol=1e-6)
+
+    def test_cosine(self):
+        s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_noam(self):
+        s = lr_mod.NoamDecay(d_model=512, warmup_steps=4000)
+        vals = []
+        for _ in range(5):
+            s.step()
+            vals.append(s())
+        assert vals[-1] > vals[0]  # rising during warmup
+
+    def test_reduce_on_plateau(self):
+        s = lr_mod.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        for m in [1.0, 1.0, 1.0, 1.0]:
+            s.step(m)
+        assert s() == 0.25 or s() == 0.5  # reduced at least once
+        assert s() < 1.0
+
+    def test_scheduler_in_optimizer(self):
+        sched = lr_mod.StepDecay(0.5, step_size=1, gamma=0.1)
+        w = nn.Parameter(np.zeros(1, dtype=np.float32))
+        o = opt.SGD(sched, parameters=[w])
+        w.grad = paddle.to_tensor(np.ones(1, dtype=np.float32))
+        o.step()
+        np.testing.assert_allclose(w.numpy(), [-0.5], rtol=1e-6)
+        sched.step()
+        w.grad = paddle.to_tensor(np.ones(1, dtype=np.float32))
+        o.step()
+        np.testing.assert_allclose(w.numpy(), [-0.55], rtol=1e-5)
