@@ -43,7 +43,7 @@ class TestOptimizersConverge:
         assert quad_problem(lambda p: opt.AdamW(0.1, parameters=p)) < 0.1
 
     def test_rmsprop(self):
-        assert quad_problem(lambda p: opt.RMSProp(0.01, parameters=p), 150) < 0.2
+        assert quad_problem(lambda p: opt.RMSProp(0.01, parameters=p), 300) < 0.1
 
     def test_adagrad(self):
         assert quad_problem(lambda p: opt.Adagrad(0.5, parameters=p)) < 0.3
