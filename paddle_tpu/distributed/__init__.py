@@ -1,0 +1,6 @@
+"""paddle.distributed surface (reference: python/paddle/distributed).
+
+Grown module-by-module; env/rank info is importable without initializing the
+communication runtime.
+"""
+from .env import ParallelEnv, get_rank, get_world_size, is_initialized

@@ -1,0 +1,69 @@
+"""Distributed environment state (reference: the PADDLE_TRAINER_* env
+contract parsed by python/paddle/distributed/parallel.py:93).
+
+Single source of truth for rank/world-size.  Populated from environment
+variables at import (set by ``paddle_tpu.distributed.launch`` or an external
+launcher) and finalized by ``init_parallel_env``.
+"""
+from __future__ import annotations
+
+import os
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.device_id = int(os.environ.get("FLAGS_selected_tpus",
+                                            os.environ.get("FLAGS_selected_gpus", "0")))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = eps.split(",") if eps else []
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+_env = None
+_initialized = False
+
+
+def _parallel_env() -> ParallelEnv:
+    global _env
+    if _env is None:
+        _env = ParallelEnv()
+    return _env
+
+
+def get_rank() -> int:
+    import jax
+
+    if _initialized:
+        return jax.process_index()
+    return _parallel_env().rank
+
+
+def get_world_size() -> int:
+    import jax
+
+    if _initialized:
+        return jax.process_count()
+    return _parallel_env().world_size
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def _mark_initialized():
+    global _initialized
+    _initialized = True

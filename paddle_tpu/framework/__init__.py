@@ -1,0 +1,4 @@
+"""paddle.framework (reference: python/paddle/framework)."""
+from .io import save, load
+from ..core.rng import seed, get_rng_state, set_rng_state
+from ..core.dtype import set_default_dtype, get_default_dtype

@@ -1,0 +1,93 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:574,791).
+
+Serialization contract: nested containers of Tensors/ndarrays/python scalars,
+pickled with Tensors converted to a tagged numpy payload (dtype-preserving,
+bfloat16 stored as uint16 view + tag).  Loads back as framework Tensors by
+default, or numpy with ``return_numpy=True`` — the reference's
+``paddle.load(..., return_numpy=...)`` contract.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+
+class _TensorPayload:
+    """Pickle-stable tensor representation."""
+
+    __slots__ = ("data", "dtype_name", "name", "stop_gradient")
+
+    def __init__(self, tensor: Tensor):
+        arr = np.asarray(tensor.numpy())
+        self.dtype_name = str(tensor.dtype)
+        if self.dtype_name == "bfloat16":
+            arr = arr.view(np.uint16)
+        self.data = arr
+        self.name = tensor.name
+        self.stop_gradient = tensor.stop_gradient
+
+    def restore(self) -> Tensor:
+        arr = self.data
+        if self.dtype_name == "bfloat16":
+            import ml_dtypes
+
+            arr = arr.view(ml_dtypes.bfloat16)
+        t = to_tensor(arr, stop_gradient=self.stop_gradient)
+        t.name = self.name
+        return t
+
+    def restore_numpy(self):
+        arr = self.data
+        if self.dtype_name == "bfloat16":
+            import ml_dtypes
+
+            arr = arr.view(ml_dtypes.bfloat16)
+        return arr
+
+
+def _convert_for_save(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _convert_for_save(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_convert_for_save(o) for o in obj)
+    return obj
+
+
+def _convert_for_load(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.restore_numpy() if return_numpy else obj.restore()
+    if isinstance(obj, dict):
+        return {k: _convert_for_load(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_convert_for_load(o, return_numpy) for o in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save: state_dicts, tensors, nested containers."""
+    if hasattr(path, "write"):
+        f = path
+        pickle.dump(_convert_for_save(obj), f, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_convert_for_save(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load."""
+    if hasattr(path, "read"):
+        obj = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    return _convert_for_load(obj, return_numpy=return_numpy)

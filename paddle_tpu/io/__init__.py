@@ -1,0 +1,10 @@
+"""paddle.io surface (reference: python/paddle/io/__init__.py)."""
+from .dataset import (
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, get_worker_info, default_collate_fn

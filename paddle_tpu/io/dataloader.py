@@ -1,0 +1,242 @@
+"""DataLoader (reference: python/paddle/fluid/dataloader/dataloader_iter.py,
+worker.py; C++ side operators/reader + blocking_queue.h).
+
+TPU-native design: the loader is a host-side prefetch pipeline feeding numpy
+batches; device transfer happens at ``to_tensor`` time (one H2D per batch).
+num_workers>0 uses spawned worker processes with an index queue / result queue
+pair and an in-order reordering buffer — the process topology of the
+reference's _DataLoaderIterMultiProcess without the C++ blocking queue (jax
+owns the device; the host queue is plain multiprocessing).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, SequenceSampler, RandomSampler
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: Any
+    seed: int = 0
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched Tensors (reference: collate.py)."""
+    from ..core.tensor import Tensor, to_tensor
+
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        arrs = [np.asarray(s.numpy()) for s in batch]
+        return to_tensor(np.stack(arrs))
+    if isinstance(sample, np.ndarray):
+        return to_tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return to_tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return to_tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _fetch_batch(dataset, indices, collate_fn):
+    if isinstance(dataset, IterableDataset):
+        raise RuntimeError("internal: iterable datasets fetch by iterator")
+    samples = [dataset[i] for i in indices]
+    return collate_fn(samples)
+
+
+def _np_ify(obj):
+    """Convert Tensors to numpy for cross-process transport."""
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return obj.numpy()
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_np_ify(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _np_ify(v) for k, v in obj.items()}
+    return obj
+
+
+def _tensor_ify(obj):
+    from ..core.tensor import to_tensor
+
+    if isinstance(obj, np.ndarray):
+        return to_tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tensor_ify(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tensor_ify(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn,
+                 worker_init_fn, worker_id, num_workers):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            data = _fetch_batch(dataset, indices, collate_fn)
+            result_queue.put((batch_id, _np_ify(data), None))
+        except Exception as e:  # propagate to parent
+            import traceback
+
+            result_queue.put((batch_id, None, traceback.format_exc()))
+
+
+class _MultiProcessIter:
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        self.loader = loader
+        ctx = mp.get_context("spawn" if loader.use_spawn else "fork")
+        self.index_queues = []
+        self.result_queue = ctx.Queue()
+        self.workers = []
+        self.batches = list(loader.batch_sampler)
+        self.n_batches = len(self.batches)
+        self.next_dispatch = 0
+        self.next_yield = 0
+        self.reorder = {}
+        n = loader.num_workers
+        for wid in range(n):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self.result_queue, loader.collate_fn,
+                      loader.worker_init_fn, wid, n),
+                daemon=True,
+            )
+            w.start()
+            self.workers.append(w)
+            self.index_queues.append(iq)
+        # prime the pipeline
+        for _ in range(min(2 * n, self.n_batches)):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self.next_dispatch >= self.n_batches:
+            return
+        wid = self.next_dispatch % len(self.workers)
+        self.index_queues[wid].put(
+            (self.next_dispatch, self.batches[self.next_dispatch]))
+        self.next_dispatch += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_yield >= self.n_batches:
+            self._shutdown()
+            raise StopIteration
+        while self.next_yield not in self.reorder:
+            batch_id, data, err = self.result_queue.get(
+                timeout=self.loader.timeout or 600)
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self.reorder[batch_id] = data
+        data = self.reorder.pop(self.next_yield)
+        self.next_yield += 1
+        self._dispatch()
+        return _tensor_ify(data)
+
+    def _shutdown(self):
+        for iq in self.index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+class DataLoader:
+    """paddle.io.DataLoader (reference: python/paddle/fluid/reader.py:326)."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_spawn = True
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle,
+                batch_size=batch_size if batch_size is not None else 1,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers > 0:
+            return _MultiProcessIter(self)
+        return self._iter_single()
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield _fetch_batch(self.dataset, indices, self.collate_fn)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)

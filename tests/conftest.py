@@ -25,3 +25,7 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _plat)
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")

@@ -1,0 +1,172 @@
+"""io (Dataset/DataLoader/Sampler) + save/load tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import io
+
+
+class RangeDataset(io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.array([i], dtype=np.float32), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        xs = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        ys = paddle.to_tensor(np.arange(6, dtype=np.int64))
+        ds = io.TensorDataset([xs, ys])
+        assert len(ds) == 6
+        x0, y0 = ds[2]
+        np.testing.assert_allclose(x0.numpy(), [4, 5])
+        assert int(y0) == 2
+
+    def test_concat_and_subset(self):
+        a, b = RangeDataset(3), RangeDataset(4)
+        cat = io.ConcatDataset([a, b])
+        assert len(cat) == 7
+        np.testing.assert_allclose(cat[5][0], [2])
+        sub = io.Subset(b, [1, 3])
+        assert len(sub) == 2
+        np.testing.assert_allclose(sub[1][0], [3])
+
+    def test_random_split(self):
+        tr, va = io.random_split(RangeDataset(10), [7, 3])
+        assert len(tr) == 7 and len(va) == 3
+
+
+class TestSamplers:
+    def test_batch_sampler(self):
+        bs = io.BatchSampler(RangeDataset(10), batch_size=3)
+        batches = list(bs)
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+        bs2 = io.BatchSampler(RangeDataset(10), batch_size=3, drop_last=True)
+        assert len(list(bs2)) == 3
+
+    def test_random_sampler_is_permutation(self):
+        rs = io.RandomSampler(RangeDataset(8))
+        idx = list(rs)
+        assert sorted(idx) == list(range(8))
+
+    def test_distributed_batch_sampler_partitions(self):
+        ds = RangeDataset(8)
+        seen = []
+        for rank in range(2):
+            s = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                           rank=rank)
+            for b in s:
+                seen.extend(b)
+        assert sorted(seen) == list(range(8))
+
+    def test_distributed_sampler_pads(self):
+        ds = RangeDataset(7)
+        total = []
+        for rank in range(2):
+            s = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                           rank=rank)
+            for b in s:
+                total.extend(b)
+        assert len(total) == 8  # padded to even division
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        dl = io.DataLoader(RangeDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 1]
+        assert y.shape == [4]
+        np.testing.assert_allclose(x.numpy().ravel(), [0, 1, 2, 3])
+
+    def test_shuffle_covers_all(self):
+        dl = io.DataLoader(RangeDataset(12), batch_size=4, shuffle=True)
+        seen = []
+        for x, y in dl:
+            seen.extend(x.numpy().ravel().astype(int).tolist())
+        assert sorted(seen) == list(range(12))
+
+    def test_iterable_dataset(self):
+        class Stream(io.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.array([i], dtype=np.float32)
+
+        dl = io.DataLoader(Stream(), batch_size=3)
+        shapes = [b.shape for b in dl]
+        assert shapes == [[3, 1], [3, 1], [1, 1]]
+
+    def test_collate_dict(self):
+        class DictDS(io.Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.array([i, i], dtype=np.int64)}
+
+            def __len__(self):
+                return 4
+
+        dl = io.DataLoader(DictDS(), batch_size=2)
+        b0 = next(iter(dl))
+        assert b0["a"].shape == [2]
+        assert b0["b"].shape == [2, 2]
+
+    @pytest.mark.slow
+    def test_multiprocess_workers(self):
+        dl = io.DataLoader(RangeDataset(20), batch_size=5, num_workers=2)
+        seen = []
+        for x, y in dl:
+            seen.extend(x.numpy().ravel().astype(int).tolist())
+        assert seen == list(range(20))  # order preserved
+
+
+class TestSaveLoad:
+    def test_tensor_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.pdtensor")
+        t = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32))
+        paddle.save(t, p)
+        t2 = paddle.load(p)
+        np.testing.assert_allclose(t2.numpy(), t.numpy())
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        p = str(tmp_path / "model.pdparams")
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        paddle.save(net.state_dict(), p)
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        net2.set_state_dict(paddle.load(p))
+        np.testing.assert_allclose(net2[0].weight.numpy(), net[0].weight.numpy())
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        p = str(tmp_path / "bf16.pdtensor")
+        t = paddle.to_tensor(np.random.randn(4).astype(np.float32)).astype("bfloat16")
+        paddle.save(t, p)
+        t2 = paddle.load(p)
+        assert str(t2.dtype) == "bfloat16"
+        np.testing.assert_allclose(
+            t2.astype("float32").numpy(), t.astype("float32").numpy())
+
+    def test_optimizer_state_roundtrip(self, tmp_path):
+        p = str(tmp_path / "opt.pdopt")
+        net = nn.Linear(3, 3)
+        o = opt.Adam(0.01, parameters=net.parameters())
+        net(paddle.to_tensor(np.ones((2, 3), dtype=np.float32))).sum().backward()
+        o.step()
+        paddle.save(o.state_dict(), p)
+        o2 = opt.Adam(0.01, parameters=net.parameters())
+        o2.set_state_dict(paddle.load(p))
+        assert o2._global_step == 1
+
+    def test_load_return_numpy(self, tmp_path):
+        p = str(tmp_path / "t.pd")
+        paddle.save({"w": paddle.to_tensor(np.ones(3, dtype=np.float32))}, p)
+        d = paddle.load(p, return_numpy=True)
+        assert isinstance(d["w"], np.ndarray)
