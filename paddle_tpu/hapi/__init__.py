@@ -1,0 +1,4 @@
+"""hapi: high-level Model API (reference: python/paddle/hapi)."""
+from .model import Model
+from . import callbacks
+from .summary import summary

@@ -1,0 +1,287 @@
+"""paddle.Model — the Keras-like high-level API (reference:
+python/paddle/hapi/model.py:915; fit at :1574).
+
+TPU-native design: one adapter (no dynamic/static split — jax.jit *is* the
+static path and is applied under ``Model.prepare(..., jit=True)`` or
+``paddle.jit.to_static`` on the network).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..metric import Metric
+from . import callbacks as cbks_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self.stop_training = False
+        self._amp_level = None
+
+    # -- configuration -----------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        return self
+
+    # -- single-batch paths --------------------------------------------------
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        lbls = labels if isinstance(labels, (list, tuple)) else [labels]
+        if callable(self._loss):
+            return self._loss(*outs, *lbls)
+        raise ValueError("Model.prepare(loss=...) required for training")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else to_tensor(x) for x in ins]
+
+        def _run():
+            outputs = self.network(*ins)
+            loss = self._compute_loss(outputs, labels)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            return outputs, loss
+
+        if self._amp_level in ("O1", "O2"):
+            from .. import amp as amp_mod
+
+            with amp_mod.auto_cast(level=self._amp_level):
+                outputs = self.network(*ins)
+            loss = self._compute_loss(outputs, labels)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        else:
+            outputs, loss = _run()
+        metrics = [float(np.asarray(loss.numpy()))]
+        for m in self._metrics:
+            pre = m.compute(outputs if not isinstance(outputs, (list, tuple))
+                            else outputs[0],
+                            labels if not isinstance(labels, (list, tuple))
+                            else labels[0])
+            if isinstance(pre, tuple):
+                m.update(*pre)
+            else:
+                m.update(pre)
+        return metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else to_tensor(x) for x in ins]
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            outputs = self.network(*ins)
+            loss_val = None
+            if self._loss is not None and labels is not None:
+                loss_val = float(np.asarray(
+                    self._compute_loss(outputs, labels).numpy()))
+        for m in self._metrics:
+            pre = m.compute(outputs if not isinstance(outputs, (list, tuple))
+                            else outputs[0],
+                            labels if not isinstance(labels, (list, tuple))
+                            else labels[0])
+            if isinstance(pre, tuple):
+                m.update(*pre)
+            else:
+                m.update(pre)
+        return [loss_val] if loss_val is not None else []
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else to_tensor(x) for x in ins]
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            return self.network(*ins)
+
+    # -- loops ----------------------------------------------------------------
+
+    @staticmethod
+    def _unpack(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return batch[0], batch[1]
+            return batch[0], None
+        return batch, None
+
+    def _make_loader(self, data, batch_size, shuffle):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._make_loader(train_data, batch_size, shuffle)
+        eval_loader = self._make_loader(eval_data, batch_size, False)
+
+        cbs = [cbks_mod.ProgBarLogger(log_freq, verbose=verbose)]
+        if save_dir:
+            cbs.append(cbks_mod.ModelCheckpoint(save_freq, save_dir))
+        if callbacks:
+            cbs.extend(callbacks)
+        cbk_list = cbks_mod.CallbackList(cbs)
+        cbk_list.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbk_list.set_params({
+            "epochs": epochs, "steps": steps, "verbose": verbose,
+            "batch_size": batch_size, "metrics": self._metrics_name(),
+        })
+        self.stop_training = False
+        cbk_list.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbk_list.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            step_count = 0
+            for step, batch in enumerate(train_loader):
+                cbk_list.on_train_batch_begin(step)
+                x, y = self._unpack(batch)
+                update = ((step + 1) % accumulate_grad_batches == 0)
+                outs = self.train_batch(x, y, update=update)
+                logs = {"loss": outs[0]}
+                for m in self._metrics:
+                    logs[_name_str(m.name())] = _fmt_metric(m.accumulate())
+                cbk_list.on_train_batch_end(step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    break
+            cbk_list.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, batch_size=batch_size,
+                                          verbose=0, _callbacks=cbk_list)
+        cbk_list.on_train_end()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        cbk_list = _callbacks or cbks_mod.CallbackList(
+            [cbks_mod.ProgBarLogger(log_freq, verbose=verbose)])
+        if _callbacks is None:
+            cbk_list.set_model(self)
+            cbk_list.set_params({"verbose": verbose})
+        for m in self._metrics:
+            m.reset()
+        cbk_list.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbk_list.on_eval_batch_begin(step)
+            x, y = self._unpack(batch)
+            outs = self.eval_batch(x, y)
+            if outs:
+                losses.append(outs[0])
+            cbk_list.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[_name_str(m.name())] = _fmt_metric(m.accumulate())
+        cbk_list.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            x, _ = self._unpack(batch)
+            out = self.predict_batch(x)
+            outputs.append(out)
+        if stack_outputs and outputs:
+            first = outputs[0]
+            if isinstance(first, (list, tuple)):
+                outputs = [
+                    np.concatenate([np.asarray(o[i].numpy()) for o in outputs])
+                    for i in range(len(first))
+                ]
+            else:
+                outputs = np.concatenate(
+                    [np.asarray(o.numpy()) for o in outputs])
+        return outputs
+
+    # -- persistence -----------------------------------------------------------
+
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtypes=dtype)
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        return names
+
+
+def _name_str(n):
+    return n if isinstance(n, str) else n[0]
+
+
+def _fmt_metric(v):
+    if isinstance(v, (list, tuple)):
+        return float(v[0])
+    return float(v)

@@ -1,0 +1,57 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import to_tensor
+from ..nn.layer_base import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a layer table and return {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def _hook(layer, inputs, outputs):
+        n_params = sum(p.size for p in layer._parameters.values() if p is not None)
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        try:
+            shape = list(out.shape)
+        except Exception:
+            shape = "?"
+        rows.append((type(layer).__name__, shape, n_params))
+
+    for l in net.sublayers(include_self=False):
+        hooks.append(l.register_forward_post_hook(_hook))
+
+    if input is None and input_size is not None:
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes or "float32"] * len(sizes)
+        input = [to_tensor(np.zeros(s, dtype=np.dtype(d or "float32")))
+                 for s, d in zip(sizes, dts)]
+    if input is not None:
+        ins = input if isinstance(input, (list, tuple)) else [input]
+        was_training = net.training
+        net.eval()
+        net(*ins)
+        if was_training:
+            net.train()
+    for h in hooks:
+        h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+
+    header = f"{'Layer (type)':<28}{'Output Shape':<24}{'Param #':>10}"
+    lines = ["-" * len(header), header, "=" * len(header)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<28}{str(shape):<24}{n:>10}")
+    lines += ["=" * len(header),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              "-" * len(header)]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

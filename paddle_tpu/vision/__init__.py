@@ -1,0 +1,5 @@
+"""paddle.vision (reference: python/paddle/vision)."""
+from . import models
+from . import transforms
+from . import datasets
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,165 @@
+"""Vision datasets (reference: python/paddle/vision/datasets).
+
+Zero-egress environment: loaders read local files only (standard MNIST idx /
+CIFAR pickle formats); ``download=True`` raises with instructions.  A
+``FakeData`` dataset provides deterministic synthetic images for tests and
+benchmarks (the role the reference's CI plays with imagenet100 subsets).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image classification data (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int64(idx % self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (reference: vision/datasets/mnist.py)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2",
+                 data_dir=None):
+        self.transform = transform
+        base = data_dir or os.path.expanduser("~/.cache/paddle_tpu/mnist")
+        tag = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(base, f"{tag}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(base, f"{tag}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            raise FileNotFoundError(
+                f"MNIST files not found at {image_path}; this environment has "
+                "no network egress — place the idx files there manually")
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, **kwargs):
+        kwargs.setdefault(
+            "data_dir", os.path.expanduser("~/.cache/paddle_tpu/fashion_mnist"))
+        super().__init__(**kwargs)
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-pickle tarball (vision/datasets/cifar.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        self.transform = transform
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/cifar/cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR file not found at {data_file}; no network egress — "
+                "place cifar-10-python.tar.gz there manually")
+        names = ([f"data_batch_{i}" for i in range(1, 6)] if mode == "train"
+                 else ["test_batch"])
+        xs, ys = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/cifar/cifar-100-python.tar.gz")
+        self.transform = transform
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR file not found at {data_file}; no network egress")
+        names = ["train"] if mode == "train" else ["test"]
+        xs, ys = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[b"fine_labels"])
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, dtype=np.int64)
+
+
+class DatasetFolder(Dataset):
+    """Image-folder dataset: root/class_x/xxx.npy (npy/png via numpy)."""
+
+    def __init__(self, root, loader=None, extensions=(".npy",), transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.endswith(tuple(extensions)):
+                    self.samples.append(
+                        (os.path.join(cdir, fname), self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)

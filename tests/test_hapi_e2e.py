@@ -1,0 +1,157 @@
+"""End-to-end slice: Model.fit on synthetic data (SURVEY.md §7 step 3 gate:
+'one model runs' — eager, single device, full API shape)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import io, metric
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet, resnet18
+
+
+class ToyClassifier(io.Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=256):
+        rs = np.random.RandomState(0)
+        self.x = rs.randn(n, 16).astype(np.float32)
+        w = rs.randn(16)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class TestModelFit:
+    def test_fit_decreases_loss_and_tracks_accuracy(self):
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 2))
+        model = Model(net)
+        model.prepare(
+            optimizer=opt.Adam(0.01, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=metric.Accuracy(),
+        )
+        ds = ToyClassifier()
+        first = model.train_batch(
+            paddle.to_tensor(ds.x[:32]), paddle.to_tensor(ds.y[:32]))
+        model.fit(ds, batch_size=32, epochs=3, verbose=0)
+        logs = model.evaluate(ds, batch_size=64, verbose=0)
+        assert logs["loss"] < first[0]
+        assert logs["acc"] > 0.9
+
+    def test_predict(self):
+        net = nn.Linear(4, 2)
+        model = Model(net)
+        model.prepare()
+        ds = io.TensorDataset([
+            paddle.to_tensor(np.random.randn(10, 4).astype(np.float32))])
+        preds = model.predict(ds, batch_size=4, stack_outputs=True)
+        assert preds.shape == (10, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+        model = Model(net)
+        model.prepare(optimizer=opt.Adam(0.01, parameters=net.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randint(0, 2, 8))
+        model.train_batch(x, y)
+        p = str(tmp_path / "ckpt")
+        model.save(p)
+
+        net2 = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+        model2 = Model(net2)
+        model2.prepare(optimizer=opt.Adam(0.01, parameters=net2.parameters()),
+                       loss=nn.CrossEntropyLoss())
+        model2.load(p)
+        np.testing.assert_allclose(net2[0].weight.numpy(),
+                                   net[0].weight.numpy())
+        assert model2._optimizer._global_step == 1
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi import callbacks
+
+        net = nn.Linear(16, 2)
+        model = Model(net)
+        model.prepare(optimizer=opt.SGD(0.0, parameters=net.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        ds = ToyClassifier(64)
+        es = callbacks.EarlyStopping(monitor="loss", patience=1, verbose=0)
+        model.fit(ds, eval_data=ds, batch_size=32, epochs=10, verbose=0,
+                  callbacks=[es])
+        assert model.stop_training  # lr=0 → no improvement → stopped early
+
+
+class TestVisionModels:
+    def test_lenet_forward_backward(self):
+        net = LeNet()
+        x = paddle.to_tensor(
+            np.random.randn(2, 1, 28, 28).astype(np.float32), stop_gradient=False)
+        out = net(x)
+        assert out.shape == [2, 10]
+        out.sum().backward()
+        assert net.features[0].weight.grad is not None
+
+    def test_resnet18_forward(self):
+        net = resnet18(num_classes=10)
+        net.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
+        out = net(x)
+        assert out.shape == [1, 10]
+
+    @pytest.mark.slow
+    def test_lenet_trains_on_fakedata(self):
+        paddle.seed(0)
+        net = LeNet()
+        model = Model(net)
+        model.prepare(
+            optimizer=opt.Adam(0.001, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=metric.Accuracy())
+        ds = FakeData(size=64, image_shape=(1, 28, 28), num_classes=10)
+        model.fit(ds, batch_size=16, epochs=2, verbose=0)
+        # FakeData labels are deterministic functions of index → memorizable
+        logs = model.evaluate(ds, batch_size=16, verbose=0)
+        assert logs["loss"] < 2.5
+
+
+class TestSummary:
+    def test_summary_counts_params(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        info = paddle.summary(net, (1, 4))
+        assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = metric.Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(np.array(
+            [[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], dtype=np.float32))
+        label = paddle.to_tensor(np.array([[1], [2]]))
+        correct = m.compute(pred, label)
+        m.update(correct)
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5 and top2 == 0.5 or (top1 == 0.5 and top2 == 1.0)
+
+    def test_precision_recall(self):
+        p = metric.Precision()
+        r = metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect(self):
+        a = metric.Auc()
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.3, 0.7]])
+        labels = np.array([0, 0, 1, 1])
+        a.update(preds, labels)
+        assert a.accumulate() == 1.0
