@@ -19,8 +19,13 @@ from .tensor import Tensor
 
 class Generator:
     def __init__(self, seed: int = 0):
+        from . import tensor as tensor_mod
+
         self._seed = seed
-        self._state = Tensor._wrap(jax.random.key_data(jax.random.PRNGKey(seed)))
+        # external state even if the generator is first touched inside a
+        # to_static trace (the state must be a program input, not a constant)
+        self._state = tensor_mod.external_tensor(
+            lambda: jax.random.key_data(jax.random.PRNGKey(seed)))
 
     def manual_seed(self, seed: int):
         self._seed = seed

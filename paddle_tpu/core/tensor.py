@@ -63,6 +63,9 @@ class Tensor:
         self.trainable = True
         self._version = 0
         self._backward_hooks = None
+        h = _trace_hook
+        if h is not None:
+            h.mark_created(self)
 
     @staticmethod
     def _wrap(arr, stop_gradient=True, name=None) -> "Tensor":
@@ -76,6 +79,9 @@ class Tensor:
         t.trainable = True
         t._version = 0
         t._backward_hooks = None
+        h = _trace_hook
+        if h is not None:
+            h.mark_created(t)
         return t
 
     # -- payload access (trace-aware) -------------------------------------
@@ -103,7 +109,7 @@ class Tensor:
                 if out is not None:
                     g = out._value() if isinstance(out, Tensor) else jnp.asarray(out)
         h = _trace_hook
-        cur = h.read_grad(self) if h is not None else self._grad
+        cur = h.read_grad_accum(self) if h is not None else self._grad
         new = g if cur is None else cur + g
         if h is not None:
             h.write_grad(self, new)
@@ -383,3 +389,28 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
 def register_tensor_method(name, fn):
     """Attach an op as a Tensor method (used by paddle_tpu.ops)."""
     setattr(Tensor, name, fn)
+
+
+def external_tensor(value, dtype=None) -> Tensor:
+    """Create a Tensor treated as *external persistent state* even when
+    constructed inside a to_static trace (lazily-created optimizer
+    accumulators, scheduler scalars, RNG state — anything that must become a
+    program input rather than a baked constant).  The payload is forced
+    concrete (ensure_compile_time_eval) because under jax's stackless tracing
+    any jnp op inside a trace yields a tracer."""
+    with jax.ensure_compile_time_eval():
+        if callable(value):
+            arr = value()
+        else:
+            arr = _to_jax_array(np.asarray(value), dtype, None)
+    t = Tensor.__new__(Tensor)
+    t._data = arr
+    t._grad = None
+    t._grad_node = None
+    t.stop_gradient = True
+    t.name = ""
+    t.persistable = True
+    t.trainable = False
+    t._version = 0
+    t._backward_hooks = None
+    return t

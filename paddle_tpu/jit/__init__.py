@@ -1,0 +1,256 @@
+"""paddle.jit: to_static, save/load (reference: fluid/dygraph/jit.py:163,637).
+
+``to_static`` compiles an imperative function (model forward, or a whole
+train step including backward and optimizer.step) into one cached XLA
+program per input-spec — the reference's StaticFunction + ConcreteProgram
+cache (program_translator.py:239,772) with jax.jit as the executor.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..core import dtype as dtype_mod
+from .trace import CompiledProgram, _flatten_io, spec_of
+
+
+class InputSpec:
+    """Declarative input signature (reference: paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def _to_zero_tensor(self) -> Tensor:
+        shape = [1 if (s is None or s < 0) else s for s in self.shape]
+        return Tensor._wrap(jnp.zeros(shape, dtype=self.dtype),
+                            stop_gradient=self.stop_gradient)
+
+
+class StaticFunction:
+    """Callable wrapper caching CompiledPrograms per input spec
+    (reference: dygraph_to_static/program_translator.py:239)."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._programs: dict = {}
+        self._enabled = True
+        functools.update_wrapper(self, fn)
+
+    @property
+    def program_cache(self):
+        return self._programs
+
+    def _extra_key(self, args):
+        """Mode bits that change the traced python path."""
+        from ..core.autograd import is_grad_enabled
+        from ..nn.layer_base import Layer
+
+        bits = [is_grad_enabled()]
+        owner = getattr(self._fn, "__self__", None)
+        scan = []
+        if isinstance(owner, Layer):
+            scan.append(owner)
+        for a in args:
+            if isinstance(a, Layer):
+                scan.append(a)
+        for l in scan:
+            bits.append(tuple(s.training for s in l.sublayers(include_self=True)))
+        return tuple(bits)
+
+    def __call__(self, *args, **kwargs):
+        if not self._enabled:
+            return self._fn(*args, **kwargs)
+        leaves: List[Tensor] = []
+        args_tree = _flatten_io(list(args), leaves)
+        n_args_leaves = len(leaves)
+        kwargs_tree = _flatten_io(kwargs, leaves)
+        key = (spec_of(args_tree, leaves), spec_of(kwargs_tree, leaves),
+               self._extra_key(args))
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = CompiledProgram(self._fn, args_tree, kwargs_tree)
+            prog.build(leaves)
+            self._programs[key] = prog
+        return prog(leaves)
+
+    def concrete_program_specify_input_spec(self, input_spec=None):
+        spec = input_spec or self._input_spec
+        if spec is None:
+            raise ValueError("input_spec required")
+        tensors = [s._to_zero_tensor() if isinstance(s, InputSpec) else s
+                   for s in spec]
+        return self.get_concrete_program(*tensors)
+
+    def get_concrete_program(self, *args, **kwargs):
+        leaves: List[Tensor] = []
+        args_tree = _flatten_io(list(args), leaves)
+        kwargs_tree = _flatten_io(kwargs, leaves)
+        key = (spec_of(args_tree, leaves), spec_of(kwargs_tree, leaves),
+               self._extra_key(args))
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = CompiledProgram(self._fn, args_tree, kwargs_tree)
+            prog.build(leaves)
+            self._programs[key] = prog
+        return prog
+
+    def rollback(self):
+        self._enabled = False
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator: compile a dygraph function to one XLA program
+    (reference: @paddle.jit.to_static, fluid/dygraph/jit.py:163)."""
+
+    def _decorate(fn):
+        from ..nn.layer_base import Layer
+
+        if isinstance(fn, Layer):
+            layer = fn
+            static_fwd = StaticFunction(layer.forward, input_spec)
+            layer.forward = static_fwd
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return _decorate(function)
+    return _decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+_to_static_enabled = True
+
+
+# ---------------------------------------------------------------------------
+# save / load (reference: jit.save fluid/dygraph/jit.py:637, TranslatedLayer
+# fluid/dygraph/io.py:1137).  Deployment format: jax.export serialized
+# StableHLO bytes + a params .pdparams — portable across processes and
+# loadable without the original python model code.
+# ---------------------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **configs):
+    from ..nn.layer_base import Layer
+    from ..framework.io import save as _fsave
+
+    if isinstance(layer, Layer):
+        fwd = layer.forward
+        net = layer
+    else:
+        fwd = layer
+        net = getattr(layer, "__self__", None)
+
+    if input_spec is None and isinstance(fwd, StaticFunction):
+        input_spec = fwd._input_spec
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec")
+
+    in_tensors = [s._to_zero_tensor() if isinstance(s, InputSpec) else s
+                  for s in input_spec]
+    params = dict(net.named_parameters()) if net is not None else {}
+    buffers = dict(net.named_buffers()) if net is not None else {}
+    state = {**params, **buffers}
+    names = sorted(state.keys())
+
+    was_training = net.training if net is not None else False
+    if net is not None:
+        net.eval()
+
+    raw_fn = fwd._fn if isinstance(fwd, StaticFunction) else fwd
+
+    def pure(state_arrays, in_arrays):
+        originals = [state[n]._data for n in names]
+        for n, arr in zip(names, state_arrays):
+            state[n]._data = arr
+        try:
+            outs = raw_fn(*[Tensor._wrap(a) for a in in_arrays])
+            if isinstance(outs, (list, tuple)):
+                return [o._value() for o in outs]
+            return outs._value()
+        finally:
+            for n, orig in zip(names, originals):
+                state[n]._data = orig
+
+    state_arrays = [state[n]._value() for n in names]
+    in_arrays = [t._value() for t in in_tensors]
+    exported = jax.export.export(jax.jit(pure))(
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state_arrays),
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), in_arrays),
+    )
+    blob = exported.serialize()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    _fsave({n: state[n] for n in names}, path + ".pdiparams")
+    if net is not None and was_training:
+        net.train()
+
+
+class TranslatedLayer:
+    """Inference-callable loaded from a jit.save artifact (reference:
+    fluid/dygraph/io.py:1137)."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._names = sorted(state.keys())
+        self._state = state
+
+    def __call__(self, *inputs):
+        in_arrays = [t._value() if isinstance(t, Tensor) else jnp.asarray(t)
+                     for t in inputs]
+        state_arrays = [self._state[n]._value() for n in self._names]
+        out = self._exported.call(state_arrays, in_arrays)
+        if isinstance(out, (list, tuple)):
+            outs = [Tensor._wrap(o) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor._wrap(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+    def state_dict(self):
+        return dict(self._state)
+
+
+def load(path, **configs):
+    from ..framework.io import load as _fload
+
+    with open(path + ".pdmodel", "rb") as f:
+        blob = f.read()
+    exported = jax.export.deserialize(blob)
+    state = _fload(path + ".pdiparams")
+    return TranslatedLayer(exported, state)

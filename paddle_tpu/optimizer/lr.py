@@ -27,8 +27,29 @@ class LRScheduler:
         else:
             self.last_epoch = epoch
         self.last_lr = self.get_lr()
+        self._sync_lr_tensor()
         if self.verbose:
             print(f"Epoch {self.last_epoch}: set learning rate to {self.last_lr}.")
+
+    _lr_t = None
+
+    def _lr_tensor(self):
+        """Persistent scalar Tensor mirroring last_lr; a to_static train step
+        reads lr through it so scheduler updates flow into the compiled
+        program without retracing."""
+        import numpy as np
+        from ..core import tensor as tensor_mod
+
+        if self._lr_t is None:
+            self._lr_t = tensor_mod.external_tensor(np.float32(self.last_lr))
+        return self._lr_t
+
+    def _sync_lr_tensor(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        if self._lr_t is not None:
+            self._lr_t._set_data(jnp.asarray(np.float32(self.last_lr)))
 
     def get_lr(self):
         raise NotImplementedError
@@ -268,6 +289,7 @@ class ReduceOnPlateau(LRScheduler):
                     print(f"Epoch {self.last_epoch}: reducing lr to {new_lr}.")
             self.cooldown_counter = self.cooldown
             self.num_bad_epochs = 0
+        self._sync_lr_tensor()
 
 
 class CyclicLR(LRScheduler):

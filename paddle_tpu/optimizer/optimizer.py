@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -50,8 +51,25 @@ class Optimizer:
                 "set_lr is not allowed when learning rate is an LRScheduler; "
                 "use scheduler.step() instead")
         self._learning_rate = float(value)
+        if self._lr_t is not None:
+            self._lr_t._set_data(jnp.asarray(float(value), dtype=jnp.float32))
+
+    _lr_t = None
 
     def _lr_array(self):
+        """Learning rate as a jax scalar.  Under a to_static trace the value
+        is read through a persistent Tensor so it becomes a *program input* —
+        scheduler steps and set_lr between compiled calls do not recompile
+        (the reference feeds lr as a scope variable for the same reason)."""
+        from ..core import tensor as tensor_mod
+
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate._lr_tensor()._value()
+        if tensor_mod._trace_hook is not None:
+            if self._lr_t is None:
+                self._lr_t = tensor_mod.external_tensor(
+                    np.float32(self.get_lr()))
+            return self._lr_t._value()
         return jnp.asarray(self.get_lr(), dtype=jnp.float32)
 
     # -- accumulators -------------------------------------------------------
@@ -64,11 +82,14 @@ class Optimizer:
         key = self._param_key(p)
         accs = self._accumulators.setdefault(key, {})
         if name not in accs:
+            from ..core import tensor as tensor_mod
+
             dt = dtype or p._value().dtype
-            if dtype == "master" :
-                dt = jnp.float32
-            accs[name] = Tensor._wrap(
-                jnp.full(p.shape, init, dtype=dt), stop_gradient=True)
+            shape = tuple(p.shape)
+            # external_tensor: accumulators lazily created inside a traced
+            # train step must still be persistent program state
+            accs[name] = tensor_mod.external_tensor(
+                lambda: jnp.full(shape, init, dtype=dt))
         return accs[name]
 
     # -- main entry points ---------------------------------------------------
