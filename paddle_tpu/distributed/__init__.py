@@ -1,6 +1,30 @@
 """paddle.distributed surface (reference: python/paddle/distributed).
 
-Grown module-by-module; env/rank info is importable without initializing the
-communication runtime.
+TPU-native: a named `jax.sharding.Mesh` is the communication topology; XLA
+emits ICI/DCN collectives from sharding annotations.  See mesh.py,
+collective.py, parallel.py, fleet/ for the layer-by-layer mapping.
 """
 from .env import ParallelEnv, get_rank, get_world_size, is_initialized
+from .mesh import (
+    build_mesh, hybrid_mesh, get_global_mesh, set_global_mesh,
+    ensure_global_mesh, named_sharding, axis_size, HYBRID_AXES,
+)
+from .collective import (
+    ReduceOp, Group, new_group, get_group,
+    all_reduce, all_gather, broadcast, reduce, scatter, alltoall,
+    reduce_scatter, barrier, send, recv, ppermute,
+)
+from .parallel import init_parallel_env, DataParallel
+from .strategy import DistributedStrategy
+
+from . import fleet  # noqa: E402
+from . import sharding  # noqa: E402
+from .sharding_spec import (
+    mark_sharding, shard_parameter, set_param_spec, get_param_spec, batch_spec,
+)
+
+def spawn(func=None, args=(), nprocs=-1, **kwargs):
+    raise NotImplementedError(
+        "single-controller SPMD has no per-rank process spawn; one python "
+        "process drives every chip — call the function directly (use "
+        "paddle_tpu.distributed.launch for multi-host jobs)")

@@ -1,0 +1,155 @@
+"""Pipeline model description: LayerDesc / SharedLayerDesc / PipelineLayer.
+
+Reference parity: pp_layers.py (LayerDesc :58, SharedLayerDesc :77,
+PipelineLayer :162, `_segment_network` :319) — a flat list of layer
+descriptors segmented into stages by uniform count or parameter weight.
+
+TPU-native design: the single controller holds the WHOLE model; a "stage"
+is a segment whose parameters are placed on the `pipe` mesh axis slice.
+`forward` runs the segments sequentially — correct semantics on any mesh —
+and the PipelineParallel engine (pipeline_parallel.py) overlays the 1F1B
+microbatch schedule inside one compiled program.  Stage placement is a
+sharding policy, not a process boundary.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .....nn.layer_base import Layer
+
+
+class LayerDesc:
+    """Deferred layer construction (reference: pp_layers.py:58)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer shared between stages (tied embeddings; reference
+    pp_layers.py:77).  Single-controller: sharing is literal python object
+    sharing — the grad all-reduce between owning stages
+    (allreduce_shared_weight_gradients, pipeline_parallel.py:149) is
+    unnecessary because there is one parameter with one gradient."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedLayerProxy(Layer):
+    """Runs a shared layer through its alternate forward_func."""
+
+    def __init__(self, layer: Layer, forward_func):
+        super().__init__()
+        self.shared = layer
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is None:
+            return self.shared(*args, **kwargs)
+        return self._forward_func(self.shared, *args, **kwargs)
+
+
+class PipelineLayer(Layer):
+    """Reference: pp_layers.py:162.
+
+    Args mirror the reference: `layers` is a list of Layer/LayerDesc/
+    callables; `num_stages` or `topology` gives the pipe degree;
+    `seg_method` is "uniform" or "layer:<ClassName>" (split before each
+    occurrence of the class), or a manual index list.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, recompute_ctx=None,
+                 num_virtual_pipeline_stages: Optional[int] = None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        if topology is not None:
+            self._num_stages = topology.get_dim("pipe")
+        else:
+            self._num_stages = int(num_stages or 1)
+
+        self._descs = list(layers)
+        self._shared: dict = {}
+        built: List[Layer] = []
+        for d in self._descs:
+            built.append(self._build_one(d))
+        self.run_function = built
+        for i, l in enumerate(built):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(i), l)
+
+        self.segment_parts = self._segment_network(seg_method)
+
+    def _build_one(self, d):
+        if isinstance(d, SharedLayerDesc):
+            if d.layer_name not in self._shared:
+                self._shared[d.layer_name] = d.build_layer()
+            return _SharedLayerProxy(self._shared[d.layer_name], d.forward_func)
+        if isinstance(d, LayerDesc):
+            return d.build_layer()
+        return d  # Layer instance or plain callable
+
+    # -- segmentation (reference: _segment_network :319) -------------------
+    def _segment_network(self, seg_method) -> List[int]:
+        n = len(self.run_function)
+        k = self._num_stages
+        if isinstance(seg_method, (list, tuple)):
+            parts = list(seg_method)
+            assert len(parts) == k + 1
+            return parts
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [i for i, l in enumerate(self.run_function)
+                     if type(l).__name__ == cls_name or
+                     (isinstance(l, _SharedLayerProxy) and type(l.shared).__name__ == cls_name)]
+            # split the marked layers evenly over stages; leading unmarked
+            # layers join stage 0, trailing join the last stage
+            if len(marks) >= k:
+                chunk = len(marks) / k
+                parts = [0]
+                for s in range(1, k):
+                    parts.append(marks[int(round(chunk * s))])
+                parts.append(n)
+                return parts
+        # uniform by layer count
+        chunk = n / k
+        parts = [int(round(chunk * s)) for s in range(k)] + [n]
+        return parts
+
+    def get_stage_from_index(self, layer_idx: int) -> int:
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def stage_layers(self, stage: int) -> List:
+        return self.run_function[self.segment_parts[stage]:self.segment_parts[stage + 1]]
+
+    def forward(self, input):
+        x = input
+        for i, layer in enumerate(self.run_function):
+            args = x if isinstance(x, tuple) else (x,)
+            if (self._recompute_interval > 0 and isinstance(layer, Layer)
+                    and i % self._recompute_interval == 0):
+                from ...utils.recompute import recompute
+                x = recompute(layer, *args)
+            else:
+                x = layer(*args)
+        return x

@@ -1,0 +1,79 @@
+"""TP-consistent RNG state tracking.
+
+Reference parity: RNGStatesTracker (fleet/meta_parallel/parallel_layers/
+random.py:32) — named RNG states so dropout inside TP regions uses a
+*local* seed (different per model-parallel rank) while replicated regions
+use the *global* seed (same across ranks; local_seed derivation :93-99).
+
+TPU-native: RNG is functional (threaded jax PRNG keys) and dropout masks
+are themselves sharded arrays under GSPMD, so "per-rank differing mask"
+falls out of partitioning a single logical mask — one seed is enough and
+always consistent.  The tracker remains for API parity and for seeding
+disjoint named streams.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from .....core import rng as rng_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, object] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = rng_mod.get_rng_state()
+        rng_mod.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = rng_mod.get_rng_state()
+            rng_mod.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 100):
+    """Reference random.py local_seed derivation (:93-99): local = seed +
+    2048 + mp_rank; global = seed.  Single-controller: one mp-local stream
+    is enough (masks are partitioned), derived at a fixed offset."""
+    from ...topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    rng_mod.seed(seed)
+    tracker.add(MODEL_PARALLEL_RNG, seed + 2048 + mp_rank)

@@ -1,0 +1,98 @@
+"""PipelineParallel engine.
+
+Reference parity: PipelineParallel.train_batch / forward_backward_pipeline
+(fleet/meta_parallel/pipeline_parallel.py:82,154) — splits the batch into
+micro-batches, runs the 1F1B schedule over stages, accumulates gradients,
+then steps the optimizer once.
+
+TPU-native design: stages are mesh placements, not processes, so the
+*semantics* of train_batch (grad accumulation over micro-batches + single
+optimizer step + mean loss) are expressed directly; the 1F1B interleave is
+a scheduling concern XLA handles when the per-microbatch step is compiled
+over the "pipe" axis (the compiled scan/ppermute schedule lives in
+pp_schedule.py once stage placement is active).  This engine is correct on
+any mesh and is the train_batch API surface.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+from .parallel_layers.pp_layers import PipelineLayer
+from .tensor_parallel import place_parameters, shard_batch
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pcfg = strategy.pipeline_configs if strategy is not None else None
+        self.accumulate_steps = pcfg.accumulate_steps if pcfg else 1
+        self.micro_batch_size = pcfg.micro_batch_size if pcfg else 1
+        place_parameters(layers, hcg.mesh if hcg else None)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_micro(self, t: Tensor, n: int):
+        if not isinstance(t, Tensor) or n <= 1:
+            return [t] * max(n, 1)
+        arr = t._value()
+        if arr.shape[0] % n != 0:
+            return [t] * n
+        size = arr.shape[0] // n
+        return [Tensor._wrap(arr[i * size:(i + 1) * size],
+                             stop_gradient=t.stop_gradient) for i in range(n)]
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference: pipeline_parallel.py:154 — returns the mean micro loss."""
+        inputs, labels = data
+        n = max(self.accumulate_steps, 1)
+        micro_x = self._split_micro(inputs, n)
+        micro_y = self._split_micro(labels, n)
+        total = None
+        for mx, my in zip(micro_x, micro_y):
+            mx = shard_batch(mx, self._hcg.mesh if self._hcg else None)
+            out = self._layers(mx)
+            if self._layers._loss_fn is None:
+                raise ValueError("PipelineLayer needs loss_fn for train_batch")
+            loss = self._layers._loss_fn(out, my)
+            if hasattr(loss, "mean") and loss.ndim > 0:
+                loss = loss.mean()
+            scaled = loss / n  # grads accumulate over micro-batches
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total / n
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss and self._layers._loss_fn is not None:
+            loss = self._layers._loss_fn(out, labels)
+            return loss.mean() if hasattr(loss, "mean") and loss.ndim > 0 else loss
+        return out
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

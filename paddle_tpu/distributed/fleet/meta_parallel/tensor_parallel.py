@@ -1,0 +1,102 @@
+"""TensorParallel / ShardingParallel model wrappers.
+
+Reference parity: TensorParallel (meta_parallel/tensor_parallel.py:25 —
+broadcasts params/inputs across the mp group) and ShardingParallel.
+
+TPU-native: "broadcast params so ranks agree" is meaningless under a single
+controller (there is one copy); the wrapper's job is *placement* — commit
+every parameter to the hybrid mesh per its PartitionSpec annotation (TP
+layers annotate; everything else replicates) and shard incoming batches
+over the data/sharding axes.  XLA then partitions the whole step.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+from ... import mesh as mesh_mod
+from ...sharding_spec import (
+    BATCH_AXES, SEQ_AXIS, get_param_spec, zero_spec, _filter_spec, _divisible,
+)
+
+
+def place_parameters(layer: Layer, mesh=None, zero_params: bool = False,
+                     zero_axis: str = "sharding"):
+    """Commit every param/buffer of `layer` onto the mesh per its spec.
+    `zero_params=True` additionally shards spec-free dims over `zero_axis`
+    (ZeRO stage-3 placement)."""
+    m = mesh or mesh_mod.ensure_global_mesh()
+    for t in list(layer.parameters()) + [b for _, b in layer.named_buffers()]:
+        arr = t._value()
+        if not hasattr(arr, "shape") or isinstance(arr, jax.core.Tracer):
+            continue
+        spec = get_param_spec(t) or P()
+        spec = _filter_spec(spec, m)
+        if zero_params:
+            spec = zero_spec(arr.shape, spec, m, axis=zero_axis)
+            spec = _filter_spec(spec, m)
+        if not _divisible(arr.shape, spec, m):
+            spec = P()
+        t._set_data(jax.device_put(arr, NamedSharding(m, spec)))
+    return layer
+
+
+def shard_batch(t, mesh=None, seq_dim=None):
+    """Place one input tensor: dim0 over (data, sharding), seq_dim over sep."""
+    if not isinstance(t, Tensor):
+        return t
+    m = mesh or mesh_mod.get_global_mesh()
+    arr = t._value()
+    if m is None or isinstance(arr, jax.core.Tracer) or arr.ndim == 0:
+        return t
+    entries = [None] * arr.ndim
+    entries[0] = tuple(a for a in BATCH_AXES if m.shape.get(a, 1) > 1) or None
+    if seq_dim is not None and arr.ndim > seq_dim and m.shape.get(SEQ_AXIS, 1) > 1:
+        entries[seq_dim] = SEQ_AXIS
+    spec = P(*entries)
+    if not _divisible(arr.shape, spec, m):
+        return t
+    out = Tensor._wrap(jax.device_put(arr, NamedSharding(m, spec)),
+                       stop_gradient=t.stop_gradient)
+    return out
+
+
+class _ParallelWrapperBase(Layer):
+    def __init__(self, layers: Layer, hcg=None, seq_dim=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._seq_dim = seq_dim
+        mesh = hcg.mesh if hcg is not None else None
+        place_parameters(layers, mesh)
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh if self._hcg is not None else None
+        inputs = tuple(shard_batch(x, mesh, self._seq_dim) for x in inputs)
+        kwargs = {k: shard_batch(v, mesh, self._seq_dim) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+class TensorParallel(_ParallelWrapperBase):
+    pass
+
+
+class ShardingParallel(_ParallelWrapperBase):
+    pass

@@ -1,0 +1,196 @@
+"""Hybrid-parallel topology: axis math + per-axis communication groups.
+
+Reference parity: CommunicateTopology / HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:52,134) — the 4-D
+cartesian topology [data, pipe, sharding, model], one comm group per axis,
+rank↔coordinate maps.  TPU-native: the topology IS a named
+`jax.sharding.Mesh` (plus a "sep" sequence-parallel axis the reference
+lacks, SURVEY.md §5.7); per-axis "groups" are mesh sub-axes, and the Group
+objects here exist for API/test parity (rank enumeration, stacked eager
+collectives) — compiled programs never use them.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..collective import Group, new_group
+from .. import mesh as mesh_mod
+
+
+class CommunicateTopology:
+    """Pure coordinate math over the hybrid axes (reference: topology.py:52)."""
+
+    def __init__(self,
+                 hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "sep", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        self._world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in itertools.product(*ranges)]
+        self._coord2rank = dict(zip(all_coords, range(self._world_size)))
+        self._rank2coord = dict(zip(self._coord2rank.values(), self._coord2rank.keys()))
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        assert len(kwargs) == len(self._parallel_names)
+        return self._coord2rank[self.coordinate(**kwargs)]
+
+    def get_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on `axis_name` equals `index`."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that communicate along `axis_name` (vary that axis,
+        fix the others) — reference topology.py get_comm_list."""
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [range(d) for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for other in itertools.product(*other_ranges):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = self.get_coord(global_rank)
+        tf = coord._replace(**kwargs)._asdict()
+        return self.get_rank(**tf)
+
+
+class HybridCommunicateGroup:
+    """Per-axis groups + the global hybrid mesh (reference: topology.py:134).
+
+    In the single-controller model every "rank" is a device coordinate; this
+    object answers rank/size queries for the device identified by
+    `global_rank` (default 0 — queries are usually made for specs, not for
+    data placement, because GSPMD handles placement).
+    """
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+
+        # the hybrid mesh is the real communication topology
+        self.mesh = mesh_mod.hybrid_mesh(
+            dp=self._dp_degree, pp=self._pp_degree,
+            sharding=self._sharding_degree, sep=self._sep_degree,
+            mp=self._mp_degree)
+        mesh_mod.set_global_mesh(self.mesh)
+
+        # Group objects per axis (for eager/stacked collectives + parity)
+        self._groups: Dict[str, Group] = {}
+        for name in topology.get_hybrid_group_names():
+            ranks = self._axis_ranks(name)
+            self._groups[name] = Group(ranks, gid=len(self._groups) + 1)
+
+        # check group: the dp×sharding cartesian product — every rank that
+        # shares this rank's (pipe, sep, model) coordinates (reference
+        # topology "check" group over data+sharding jointly)
+        coord = topology.get_coord(global_rank)._asdict()
+        fixed = [n for n in topology.get_hybrid_group_names()
+                 if n not in ("data", "sharding")]
+        dp_sd = sorted(
+            r for c, r in topology._coord2rank.items()
+            if all(c._asdict()[n] == coord[n] for n in fixed))
+        self._check_group = Group(dp_sd, gid=100)
+
+    def _axis_ranks(self, axis_name: str) -> List[int]:
+        for grp in self._topo.get_comm_list(axis_name):
+            if self.global_rank in grp:
+                return grp
+        return [self.global_rank]
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        # reference enum ParallelMode (topology.py:46-49)
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1:
+            return "DATA_PARALLEL" if self._dp_degree > 1 else "SINGLE"
+        if self._sharding_degree > 1 and self._mp_degree == 1 and self._pp_degree == 1:
+            return "SHARDING_PARALLEL"
+        if self._pp_degree > 1:
+            return "PIPELINE_PARALLEL"
+        return "TENSOR_PARALLEL"
+
+    # -- per-axis rank/size/group queries (reference API names) ------------
+    def _coord(self):
+        return self._topo.get_coord(self.global_rank)
+
+    def get_data_parallel_world_size(self): return self._dp_degree
+    def get_data_parallel_rank(self): return self._coord().data
+    def get_data_parallel_group(self): return self._groups["data"]
+    def get_data_parallel_group_src_rank(self): return self._groups["data"].ranks[0]
+
+    def get_model_parallel_world_size(self): return self._mp_degree
+    def get_model_parallel_rank(self): return self._coord().model
+    def get_model_parallel_group(self): return self._groups["model"]
+    def get_model_parallel_group_src_rank(self): return self._groups["model"].ranks[0]
+
+    def get_pipe_parallel_world_size(self): return self._pp_degree
+    def get_stage_id(self): return self._coord().pipe
+    def get_pipe_parallel_group(self): return self._groups["pipe"]
+
+    def get_sharding_parallel_world_size(self): return self._sharding_degree
+    def get_sharding_parallel_rank(self): return self._coord().sharding
+    def get_sharding_parallel_group(self): return self._groups["sharding"]
+    def get_sharding_parallel_group_src_rank(self): return self._groups["sharding"].ranks[0]
+
+    def get_sep_parallel_world_size(self): return self._sep_degree
+    def get_sep_parallel_rank(self): return self._coord().sep
+    def get_sep_parallel_group(self): return self._groups["sep"]
+
+    def get_check_parallel_group(self): return self._check_group
+
+    def is_first_stage(self): return self.get_stage_id() == 0
+    def is_last_stage(self): return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_next_rank(self):
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=(self.get_stage_id() + 1) % self._pp_degree)
+
+    def get_p2p_prev_rank(self):
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=(self.get_stage_id() - 1) % self._pp_degree)
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

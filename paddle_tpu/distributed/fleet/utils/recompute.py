@@ -1,0 +1,86 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference parity: RecomputeFunction (fleet/utils/recompute.py:74,136) — a
+PyLayer that saves inputs + RNG state in forward and re-runs the forward
+inside backward.
+
+TPU-native design: `jax.checkpoint` (remat) IS the mechanism — the region
+becomes one tape op whose vjp recomputes the primal inside the compiled
+backward, so under `to_static`/jit XLA drops the activations and the HBM
+saving is real.  RNG parity is automatic: dropout keys are functional
+values captured at trace time, so the replay reproduces the same mask (the
+reference must save/restore RNG state by hand).
+
+The region's parameters are lifted as explicit differentiable inputs
+(discovered from the Layer, or passed via `params=`), so their gradients
+flow exactly as the reference's re-run-with-grad does.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+from ....core import autograd
+from ....core.dispatch import apply_op
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+
+
+def _owning_layer(function) -> Optional[Layer]:
+    if isinstance(function, Layer):
+        return function
+    self_obj = getattr(function, "__self__", None)
+    if isinstance(self_obj, Layer):
+        return self_obj
+    return None
+
+
+def recompute(function, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, params: Optional[Sequence] = None,
+              **kwargs):
+    """Run `function(*args)` as a rematerialized region."""
+    layer = _owning_layer(function)
+    if params is not None:
+        externals: List[Tensor] = list(params)
+    elif layer is not None:
+        externals = list(layer.parameters())
+        externals += [b for _, b in layer.named_buffers()]
+    else:
+        # unknown closure: no remat, plain call (still correct, no memory win)
+        return function(*args, **kwargs)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+    n_args = len(tensor_args)
+    out_struct = {}
+
+    def _pure(*arrays):
+        arg_arrays = arrays[:n_args]
+        ext_arrays = arrays[n_args:]
+        call_args = list(args)
+        for j, i in enumerate(tensor_idx):
+            call_args[i] = Tensor._wrap(arg_arrays[j],
+                                        stop_gradient=args[i].stop_gradient)
+        saved = [(t, t._data) for t in externals]
+        try:
+            for t, a in zip(externals, ext_arrays):
+                t._data = a
+            # the outer jax.vjp differentiates this whole pure fn; the inner
+            # tape would be redundant work, so record nothing inside
+            with autograd.no_grad():
+                out = function(*call_args, **kwargs)
+        finally:
+            for t, a in saved:
+                t._data = a
+        if isinstance(out, (tuple, list)):
+            out_struct["n"] = len(out)
+            return tuple(o._value() if isinstance(o, Tensor) else o for o in out)
+        out_struct["n"] = 1
+        return out._value() if isinstance(out, Tensor) else out
+
+    remat_fn = jax.checkpoint(_pure)
+    all_inputs = tensor_args + list(externals)
+    out = apply_op("recompute", remat_fn, all_inputs, n_outs=1)
+    # apply_op wraps tuple outputs automatically when primal returns a tuple
+    return out

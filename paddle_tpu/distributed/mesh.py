@@ -1,0 +1,97 @@
+"""Global device-mesh state — the TPU-native replacement for the reference's
+communicator registries.
+
+Reference parity: `NCCLCommContext` ring-id→communicator map
+(paddle/fluid/platform/collective_helper.h) and eager `ProcessGroup` creation
+(paddle/fluid/distributed/collective/ProcessGroup.h:52).  TPU-native design:
+there are no explicit communicators — a single `jax.sharding.Mesh` with named
+axes is the communication topology, and XLA emits ICI/DCN collectives from
+sharding annotations (SURVEY.md §2.4 "TPU-native equivalent").
+
+One process controls all local devices (single-controller SPMD); multi-host
+runs call `jax.distributed.initialize` first (see parallel.init_parallel_env),
+after which `jax.devices()` spans the pod and the same Mesh code covers DCN.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical hybrid axis names, outermost-first. Order matters for ICI
+# locality: the innermost axis ("mp") gets mesh-adjacent devices, so
+# tensor-parallel collectives — the most latency-sensitive — ride the
+# shortest ICI hops (scaling-book recipe; reference analog: the axis order
+# of CommunicateTopology, fleet/base/topology.py:55 ["data","pipe","sharding",
+# "model"], with "sep" added for sequence parallelism which the reference
+# lacks, SURVEY.md §5.7).
+HYBRID_AXES = ("data", "pipe", "sharding", "sep", "model")
+
+_global_mesh: Optional[Mesh] = None
+
+
+def build_mesh(axes: "collections.OrderedDict[str, int] | Dict[str, int]",
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named Mesh over `devices` (default: all) with the given
+    axis→size mapping (insertion order = major→minor)."""
+    names = tuple(axes.keys())
+    sizes = tuple(int(axes[n]) for n in names)
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(sizes)) if sizes else 1
+    if n != len(devices):
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} require {n} devices, "
+            f"have {len(devices)}")
+    if jax.default_backend() == "tpu":
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=list(devices))
+    else:
+        dev_array = np.array(list(devices)).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def set_global_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def ensure_global_mesh(world_axis: str = "data") -> Mesh:
+    """The default mesh: all devices on one data axis (pure DP), created
+    lazily — the analog of the reference's implicit world ring-0."""
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = build_mesh({world_axis: len(jax.devices())})
+    return _global_mesh
+
+
+def hybrid_mesh(dp: int = 1, pp: int = 1, sharding: int = 1, sep: int = 1,
+                mp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """The 5-D hybrid mesh [data, pipe, sharding, sep, model].
+
+    Degrees of 1 keep their axis (size-1 axes are free in XLA) so sharding
+    specs can always name any hybrid axis regardless of the active strategy.
+    """
+    axes = collections.OrderedDict(
+        [("data", dp), ("pipe", pp), ("sharding", sharding),
+         ("sep", sep), ("model", mp)])
+    return build_mesh(axes, devices)
+
+
+def named_sharding(spec: PartitionSpec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    m = mesh or ensure_global_mesh()
+    return NamedSharding(m, spec)
+
+
+def axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
+    m = mesh or get_global_mesh()
+    if m is None or name not in m.shape:
+        return 1
+    return m.shape[name]

@@ -1,0 +1,126 @@
+"""init_parallel_env + DataParallel.
+
+Reference parity: python/paddle/distributed/parallel.py:93 (env parse, store
+rendezvous, ProcessGroup create) and paddle.DataParallel
+(fluid/dygraph/parallel.py:419) with its EagerReducer grad bucketing.
+
+TPU-native design: `jax.distributed.initialize` replaces the TCPStore/nccl-id
+bootstrap (SURVEY.md §2.4); after it, every chip in the pod is addressable
+from this controller and a Mesh spans them.  DataParallel needs **no
+reducer**: parameters are placed replicated on the mesh, the input batch is
+sharded over the "data" axis, and XLA's partitioner emits the gradient
+all-reduce inside the compiled backward — fused, overlapped, on ICI —
+which is strictly better than EagerReducer's hand bucketing.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import env as env_mod
+from . import mesh as mesh_mod
+
+
+def init_parallel_env():
+    """Initialize the distributed runtime.
+
+    Multi-host (env PADDLE_TRAINERS_NUM > 1 or JAX coordinator vars set):
+    calls jax.distributed.initialize using the PADDLE_* env contract the
+    launcher sets.  Single-host: just establishes the default mesh over the
+    local chips.  Idempotent.
+    """
+    if env_mod.is_initialized():
+        return env_mod._parallel_env()
+    penv = env_mod._parallel_env()
+    multi = penv.world_size > 1 and bool(penv.trainer_endpoints)
+    if multi and jax.process_count() == 1:
+        coord = os.environ.get("PADDLE_MASTER",
+                               penv.trainer_endpoints[0] if penv.trainer_endpoints else None)
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=penv.world_size,
+            process_id=penv.rank)
+    mesh_mod.ensure_global_mesh()
+    env_mod._mark_initialized()
+    return penv
+
+
+def get_rank(group=None) -> int:
+    return env_mod.get_rank()
+
+
+def get_world_size(group=None) -> int:
+    return env_mod.get_world_size()
+
+
+class DataParallel(Layer):
+    """Data-parallel wrapper (reference: fluid/dygraph/parallel.py:419).
+
+    Places every parameter replicated on the mesh and shards the input batch
+    over the "data" axis; under jit the XLA partitioner inserts the fused
+    gradient all-reduce (replacing EagerReducer,
+    distributed/collective/reducer.h:87).
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, mesh=None):
+        super().__init__()
+        self._layers = layers
+        self._mesh = mesh or mesh_mod.ensure_global_mesh()
+        axis = "data" if "data" in self._mesh.shape else list(self._mesh.shape)[0]
+        self._data_axis = axis
+        self._replicate_params()
+
+    def _replicate_params(self):
+        repl = NamedSharding(self._mesh, P())
+        for p in self._layers.parameters():
+            arr = p._value()
+            if isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer):
+                p._set_data(jax.device_put(arr, repl))
+
+    def _shard_input(self, t):
+        if not isinstance(t, Tensor):
+            return t
+        arr = t._value()
+        if isinstance(arr, jax.core.Tracer) or arr.ndim == 0:
+            return t
+        n = self._mesh.shape[self._data_axis]
+        if arr.shape[0] % n != 0:
+            return t
+        sh = NamedSharding(self._mesh, P(self._data_axis))
+        return Tensor._wrap(jax.device_put(arr, sh), stop_gradient=t.stop_gradient)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(x) for x in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    # reference API surface ------------------------------------------------
+    def scale_loss(self, loss):
+        return loss  # XLA mean over the global batch already matches 1-chip
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)

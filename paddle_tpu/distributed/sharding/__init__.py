@@ -1,0 +1,53 @@
+"""group_sharded_parallel — the ZeRO user API.
+
+Reference parity: python/paddle/distributed/sharding/group_sharded.py:40
+(`group_sharded_parallel(model, optimizer, level)` with level "os" |
+"os_g" | "p_g_os" → GroupShardedOptimizerStage2 / Stage2 / Stage3).
+
+TPU-native: each level is a placement policy on the hybrid mesh's
+"sharding" axis (falling back to "data" when no sharding axis is active):
+- "os"     → optimizer state sharded            (stage 1)
+- "os_g"   → same compiled memory behavior: gradients are transient values
+             inside the XLA program, not persistent buffers, so stage 2's
+             grad partitioning has nothing left to shard (SURVEY.md §7)
+- "p_g_os" → parameters sharded too             (stage 3, gather-on-use —
+             XLA schedules the all-gathers just-in-time)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import mesh as mesh_mod
+from ..fleet.hybrid_optimizer import _shard_accumulators
+from ..fleet.meta_parallel.tensor_parallel import place_parameters
+
+LEVELS = ("os", "os_g", "p_g_os")
+
+
+def group_sharded_parallel(model, optimizer, level: str = "os", scaler=None,
+                           group=None, offload: bool = False,
+                           sync_buffers: bool = False, buffer_max_size=None,
+                           segment_size=None, sync_comm: bool = False):
+    if level not in LEVELS:
+        raise ValueError(f"level must be one of {LEVELS}, got {level!r}")
+    mesh = mesh_mod.get_global_mesh()
+    if mesh is None:
+        # no fleet topology: treat all devices as one sharding axis
+        mesh = mesh_mod.build_mesh({"sharding": len(__import__("jax").devices())})
+        mesh_mod.set_global_mesh(mesh)
+    axis = "sharding" if mesh.shape.get("sharding", 1) > 1 else "data"
+    place_parameters(model, mesh, zero_params=(level == "p_g_os"),
+                     zero_axis=axis)
+    _shard_accumulators(optimizer, mesh, enable_zero=True, zero_axis=axis)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference: group_sharded.py save helper — state is global arrays, so
+    a plain save captures the full (unsharded) state."""
+    from ...framework.io import save
+    import os
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

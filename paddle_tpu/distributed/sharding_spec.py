@@ -1,0 +1,154 @@
+"""Parameter/activation sharding annotations — the GSPMD integration layer.
+
+Reference parity: this is the TPU-native replacement for the *mechanisms* of
+Megatron-style TP layers (mp_layers.py identity/allreduce autograd fns),
+ZeRO sharding stages (group_sharded_stage{2,3}.py) and DP reducers: instead
+of hand-inserting collectives, parameters and activations carry
+`PartitionSpec`s over the hybrid mesh and XLA's partitioner emits the
+collectives (SURVEY.md §7 design stance).
+
+Conventions:
+- a `Parameter` may carry `._pspec: PartitionSpec` (set by parallel layers
+  or `shard_parameter`); unannotated params are replicated.
+- activations are constrained via `mark_sharding(t, spec)` — a tape op that
+  lowers to `lax.with_sharding_constraint` under jit and `device_put` in
+  eager.
+- the batch dim of data tensors is sharded over ("data", "sharding") — the
+  ZeRO axis is a second batch axis, exactly how the reference composes
+  sharding-as-outer-DP (topology.py:166).
+- sequence dims shard over "sep" (context parallelism — beyond-reference
+  capability, SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from . import mesh as mesh_mod
+
+BATCH_AXES = ("data", "sharding")
+SEQ_AXIS = "sep"
+MODEL_AXIS = "model"
+
+
+def set_param_spec(param, spec: P):
+    param._pspec = spec
+    return param
+
+
+def get_param_spec(param) -> Optional[P]:
+    return getattr(param, "_pspec", None)
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh doesn't have (lets TP layers run unsharded)."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.shape and mesh.shape[a] > 1)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh.shape and mesh.shape[entry] > 1 else None)
+    return P(*out)
+
+
+def _divisible(shape, spec: P, mesh: Mesh) -> bool:
+    for dim, entry in zip(shape, spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape.get(a, 1)
+        if n > 1 and dim % n != 0:
+            return False
+    return True
+
+
+def batch_spec(ndim: int, last=None, seq_dim: Optional[int] = 1) -> P:
+    """Activation spec: dim0 over (data, sharding), seq_dim over sep, last
+    dim as given."""
+    entries = [None] * ndim
+    entries[0] = BATCH_AXES
+    if seq_dim is not None and 0 < seq_dim < ndim - 1:
+        entries[seq_dim] = SEQ_AXIS
+    if last is not None and ndim > 1:
+        entries[-1] = last
+    return P(*entries)
+
+
+def mark_sharding(t: Tensor, spec: P, mesh: Optional[Mesh] = None) -> Tensor:
+    """Constrain a tensor's sharding (differentiable tape op).
+
+    No-op when no mesh is active or the spec doesn't divide the shape —
+    so parallel layers degrade gracefully to single-device execution.
+    """
+    m = mesh or mesh_mod.get_global_mesh()
+    if m is None:
+        return t
+    spec = _filter_spec(spec, m)
+    if all(e is None for e in spec):
+        return t
+    arr = t._value() if isinstance(t, Tensor) else t
+    if not _divisible(arr.shape, spec, m):
+        return t
+    ns = NamedSharding(m, spec)
+
+    def _primal(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, ns)
+        return jax.device_put(a, ns)
+
+    if isinstance(t, Tensor):
+        return apply_op("shard_constraint", _primal, [t])
+    return _primal(t)
+
+
+def shard_parameter(param, spec: P, mesh: Optional[Mesh] = None):
+    """Annotate + immediately place a parameter."""
+    set_param_spec(param, spec)
+    m = mesh or mesh_mod.get_global_mesh()
+    if m is not None:
+        _place(param, spec, m)
+    return param
+
+
+def _place(p, spec: P, mesh: Mesh):
+    arr = p._value()
+    if isinstance(arr, jax.core.Tracer):
+        return
+    spec = _filter_spec(spec, mesh)
+    if not _divisible(arr.shape, spec, mesh):
+        spec = P()
+    p._set_data(jax.device_put(arr, NamedSharding(mesh, spec)))
+
+
+def zero_spec(shape, spec: Optional[P], mesh: Mesh, axis: str = "sharding") -> P:
+    """Compose a ZeRO shard onto a param/opt-state spec: shard the first
+    dimension the TP spec leaves free (and that divides) over `axis`
+    (reference: group_sharded optimizer-state partitioning,
+    group_sharded_optimizer_stage2.py:48 — rank-balanced param buckets;
+    here the 'bucket' is an XLA shard)."""
+    if axis not in mesh.shape or mesh.shape[axis] <= 1:
+        return spec or P()
+    entries = list(spec) if spec is not None else [None] * len(shape)
+    while len(entries) < len(shape):
+        entries.append(None)
+    n = mesh.shape[axis]
+    for i, (dim, e) in enumerate(zip(shape, entries)):
+        if e is None and dim % n == 0 and dim >= n:
+            entries[i] = axis
+            return P(*entries)
+    return P(*entries)
+
+
+def placement_of(t) -> Optional[P]:
+    arr = t._value() if isinstance(t, Tensor) else t
+    sh = getattr(arr, "sharding", None)
+    return getattr(sh, "spec", None)

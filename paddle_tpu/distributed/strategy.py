@@ -1,0 +1,109 @@
+"""DistributedStrategy — one typed config object for all parallelism knobs.
+
+Reference parity: paddle/fluid/framework/distributed_strategy.proto (352
+lines: sharding/hybrid degrees :37-55, amp :60-70, gradient merge :75-86,
+recompute/pipeline/tensor-parallel messages) + the python wrapper
+fleet/base/distributed_strategy.py.  Kept as plain dataclasses (SURVEY.md
+§5.6 "single typed config registry + strategy dataclasses").
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1  # sequence/context parallel — new capability vs reference (SURVEY.md §5.7)
+
+
+@dataclass
+class AMPConfig:
+    enable: bool = False
+    dtype: str = "bfloat16"  # TPU-native default; "float16" honored
+    level: str = "O1"
+    init_loss_scaling: float = 32768.0
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 2
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: List[str] = field(default_factory=list)
+    custom_black_list: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RecomputeConfig:
+    enable: bool = False
+    checkpoints: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ShardingConfig:
+    stage: int = 1  # 1: opt-state, 2: +grads, 3: +params (ZeRO)
+    offload: bool = False
+
+
+@dataclass
+class PipelineConfig:
+    accumulate_steps: int = 1
+    micro_batch_size: int = 1
+    schedule_mode: str = "1F1B"
+
+
+@dataclass
+class GradientMergeConfig:
+    enable: bool = False
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclass
+class MoEConfig:
+    enable: bool = False
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+class DistributedStrategy:
+    """Mutable strategy object with the fleet API shape::
+
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 2}
+        s.amp = True
+        s.amp_configs = {"dtype": "bfloat16"}
+    """
+
+    def __init__(self):
+        self.hybrid_configs = HybridConfig()
+        self.amp_configs = AMPConfig()
+        self.recompute_configs = RecomputeConfig()
+        self.sharding_configs = ShardingConfig()
+        self.pipeline_configs = PipelineConfig()
+        self.gradient_merge_configs = GradientMergeConfig()
+        self.moe_configs = MoEConfig()
+        self.amp = False
+        self.recompute = False
+        self.sharding = False
+        self.gradient_merge = False
+        self.find_unused_parameters = False
+
+    def __setattr__(self, name, value):
+        cfg = self.__dict__.get(name)
+        if isinstance(value, dict) and dataclasses.is_dataclass(cfg):
+            for k, v in value.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+                # silently ignore unknown keys like the proto wrapper does
+            return
+        object.__setattr__(self, name, value)
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()}
+        return f"DistributedStrategy({fields})"
