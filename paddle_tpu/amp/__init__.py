@@ -1,0 +1,5 @@
+"""paddle.amp — automatic mixed precision (reference: python/paddle/amp)."""
+from .auto_cast import auto_cast, amp_guard, decorate, WHITE_LIST, BLACK_LIST
+from .grad_scaler import GradScaler, AmpScaler
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler"]

@@ -1,0 +1,143 @@
+"""AMP autocast: per-op dtype policy.
+
+Reference parity: paddle.amp.auto_cast (python/paddle/amp/auto_cast.py:21)
+with the op allow/deny lists of fluid/dygraph/amp/auto_cast.py and the C++
+eager hook (eager/amp_auto_cast.h).
+
+TPU-native design: the default low dtype is **bfloat16** — TPU MXUs eat
+bf16 natively and its f32-range exponent makes loss scaling optional
+(float16 honored for parity).  The policy is applied at op dispatch via the
+`_amp_cast_hook` in core.dispatch (the same interception point the
+reference generates into every dygraph function): white-list ops cast
+inputs down (MXU-bound matmuls/convs), black-list ops cast up to f32
+(softmax/norm/loss numerics), everything else runs in whatever dtype
+arrives (O1).  O2 additionally casts params at decorate() time.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterable, Optional, Set
+
+import jax.numpy as jnp
+
+from ..core import dispatch as dispatch_mod
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+# MXU-bound ops: cast to the low dtype (reference: white list
+# fluid/dygraph/amp/auto_cast.py WHITE_LIST — matmul/conv/mul)
+WHITE_LIST: Set[str] = {
+    "matmul", "mm", "bmm", "mv", "linear", "einsum", "inner", "outer",
+    "tensordot", "multi_dot",
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "flash_attention",
+}
+
+# numerically sensitive ops: force f32 (reference: BLACK_LIST —
+# softmax/CE/norms/exp/log/pow...)
+BLACK_LIST: Set[str] = {
+    "softmax", "log_softmax", "cross_entropy", "parallel_cross_entropy",
+    "bce_with_logits", "binary_cross_entropy", "nll_loss", "kl_div",
+    "ctc_loss", "layer_norm", "batch_norm", "instance_norm", "group_norm",
+    "rms_norm", "norm", "normalize", "mean", "sum", "var", "std",
+    "cumsum", "logcumsumexp", "prod", "square_error_cost",
+}
+
+_LOW = {"bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+class AmpState:
+    def __init__(self, enable: bool, dtype: str, level: str,
+                 white: Set[str], black: Set[str]):
+        self.enable = enable
+        self.dtype = _LOW[dtype]
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+_state: Optional[AmpState] = None
+
+
+def amp_state() -> Optional[AmpState]:
+    return _state
+
+
+def _is_float(arr) -> bool:
+    return arr is not None and hasattr(arr, "dtype") and \
+        jnp.issubdtype(arr.dtype, jnp.floating)
+
+
+def _cast_args(args, target):
+    out = []
+    for a in args:
+        if isinstance(a, Tensor) and _is_float(a._value()) \
+                and a._value().dtype != target:
+            from ..ops._helpers import op as run_op
+            out.append(run_op("cast", lambda x: x.astype(target), [a]))
+        else:
+            out.append(a)
+    return out
+
+
+def _hook(name: str, tensor_args):
+    s = _state
+    if s is None or not s.enable or name == "cast":
+        # "cast" passes through or the hook's own casts would recurse
+        return tensor_args
+    if name in s.white:
+        return _cast_args(tensor_args, s.dtype)
+    if name in s.black:
+        return _cast_args(tensor_args, jnp.float32)
+    if s.level == "O2":
+        # pure-low-precision: run gray ops in the low dtype too
+        return _cast_args(tensor_args, s.dtype)
+    return tensor_args
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list: Optional[Iterable[str]] = None,
+              custom_black_list: Optional[Iterable[str]] = None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    """Context manager (reference: amp/auto_cast.py:21)."""
+    global _state
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    if dtype not in _LOW:
+        raise ValueError(f"dtype must be bfloat16/float16, got {dtype}")
+    cw, cb = set(custom_white_list or ()), set(custom_black_list or ())
+    if cw & cb:
+        raise ValueError(f"ops in both custom lists: {sorted(cw & cb)}")
+    white = (set(WHITE_LIST) | cw) - cb
+    black = (set(BLACK_LIST) | cb) - cw
+    prev_state, prev_hook = _state, dispatch_mod._amp_cast_hook
+    _state = AmpState(enable and level != "O0", dtype, level, white, black)
+    dispatch_mod._amp_cast_hook = _hook
+    try:
+        yield
+    finally:
+        _state, dispatch_mod._amp_cast_hook = prev_state, prev_hook
+
+
+amp_guard = auto_cast  # legacy alias (fluid/dygraph/amp/auto_cast.py)
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 model preparation (reference: amp/auto_cast.py:81 `decorate`):
+    cast float params to the low dtype; optimizers keep f32 master state
+    (our optimizer accumulators are f32 already — multi_precision default).
+    """
+    if level == "O1":
+        return (models, optimizers) if optimizers is not None else models
+    target = _LOW[dtype]
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    for m in model_list:
+        for p in m.parameters():
+            arr = p._value()
+            if _is_float(arr) and arr.dtype == jnp.float32:
+                p._set_data(arr.astype(target))
+    if optimizers is not None:
+        return models, optimizers
+    return models

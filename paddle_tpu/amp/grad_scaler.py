@@ -1,0 +1,169 @@
+"""Dynamic loss scaling.
+
+Reference parity: paddle.amp.GradScaler (amp/grad_scaler.py:26) →
+AmpScaler (fluid/dygraph/amp/loss_scaler.py:40) built on the
+check_finite_and_unscale + update_loss_scaling ops.
+
+TPU-native design: scaling is optional under bf16 (f32 exponent range) but
+fully supported for f16 parity.  The skip-on-inf control flow is expressed
+as `jnp.where` selects over persistent state tensors (scale / good & bad
+step counters / param & accumulator snapshots), never python branches, so
+one compiled train step handles both the apply and the skip path — the
+exact role of the reference's update_loss_scaling op, which the executor
+also runs unconditionally.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tensor as tensor_mod
+from ..core.tensor import Tensor
+from ..ops._helpers import op as run_op
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._use_dynamic = use_dynamic_loss_scaling and enable
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self._scale_t = tensor_mod.external_tensor(
+            jnp.float32(init_loss_scaling if enable else 1.0))
+        self._good_t = tensor_mod.external_tensor(jnp.int32(0))
+        self._bad_t = tensor_mod.external_tensor(jnp.int32(0))
+        self._found_inf = None  # jax bool scalar from the last step()
+        self._unscaled = False
+
+    # -- public API (reference surface) ------------------------------------
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._use_dynamic
+
+    def get_loss_scaling(self) -> float:
+        return float(jax.device_get(self._scale_t._data))
+
+    def set_init_loss_scaling(self, v: float):
+        self._scale_t._data = jnp.float32(v)
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        scale_t = self._scale_t
+        return run_op("amp_scale", lambda a, s: a * s.astype(a.dtype),
+                      [var, scale_t])
+
+    def unscale_(self, optimizer):
+        """Divide grads by the scale and latch found_inf
+        (reference: check_finite_and_unscale op)."""
+        if not self._enable:
+            self._found_inf = jnp.bool_(False)
+            return
+        if self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()")
+        inv = 1.0 / self._scale_t._value().astype(jnp.float32)
+        found = jnp.bool_(False)
+        for p in optimizer._parameter_list or []:
+            g = p.grad
+            if g is None:
+                continue
+            garr = g._value()
+            un = (garr.astype(jnp.float32) * inv).astype(garr.dtype)
+            found = found | ~jnp.all(jnp.isfinite(un.astype(jnp.float32)))
+            p.grad = un
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """unscale → snapshot → inner step → where-select rollback."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        found = self._found_inf
+        params = [p for p in (optimizer._parameter_list or [])
+                  if getattr(p, "trainable", True)]
+        old_params = {id(p): p._value() for p in params}
+        old_accs = {}
+        for key, accs in optimizer._accumulators.items():
+            for name, t in accs.items():
+                old_accs[(key, name)] = t._value()
+        optimizer.step()
+        for p in params:
+            new = p._value()
+            p._set_data(jnp.where(found, old_params[id(p)], new))
+        for key, accs in optimizer._accumulators.items():
+            for name, t in accs.items():
+                new = t._value()
+                if (key, name) in old_accs:
+                    old = old_accs[(key, name)]
+                else:
+                    # accumulator born this step: roll back to its init
+                    init = optimizer._acc_inits.get((key, name), 0.0)
+                    old = jnp.full(new.shape, init, new.dtype)
+                t._set_data(jnp.where(found, old, new))
+        self._unscaled = False
+
+    def update(self):
+        """Dynamic scale bookkeeping (reference: update_loss_scaling op)."""
+        if not self._use_dynamic or self._found_inf is None:
+            return
+        found = self._found_inf
+        good = self._good_t._value()
+        bad = self._bad_t._value()
+        scale = self._scale_t._value()
+        good = jnp.where(found, 0, good + 1)
+        bad = jnp.where(found, bad + 1, 0)
+        decr = bad >= self._decr_every_n_nan_or_inf
+        scale = jnp.where(decr, jnp.maximum(scale * self._decr_ratio, 1.0),
+                          scale)
+        bad = jnp.where(decr, 0, bad)
+        incr = good >= self._incr_every_n_steps
+        scale = jnp.where(incr, scale * self._incr_ratio, scale)
+        good = jnp.where(incr, 0, good)
+        self._good_t._set_data(good)
+        self._bad_t._set_data(bad)
+        self._scale_t._set_data(scale)
+        self._found_inf = None
+
+    def minimize(self, optimizer, scaled_loss, *args, **kwargs):
+        """reference: scaler.minimize = step + update (backward already run
+        by the caller on the scaled loss)."""
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self):
+        return {
+            "scale": self._scale_t._data,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "incr_count": self._good_t._data,
+            "decr_count": self._bad_t._data,
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale_t._data = jnp.float32(jnp.asarray(sd["scale"]))
+        self._good_t._data = jnp.int32(jnp.asarray(sd.get("incr_count", 0)))
+        self._bad_t._data = jnp.int32(jnp.asarray(sd.get("decr_count", 0)))
+        self._incr_ratio = float(sd.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(sd.get("decr_ratio", self._decr_ratio))
+
+
+AmpScaler = GradScaler  # legacy alias (fluid/dygraph/amp/loss_scaler.py:40)

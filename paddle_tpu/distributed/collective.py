@@ -107,6 +107,14 @@ def _group_or_world(group) -> Group:
     return group if isinstance(group, Group) else _world_group()
 
 
+def _group_local(g: Group, rank: int, api: str, role: str) -> int:
+    """Map a global rank to its index inside the group; reject outsiders."""
+    if rank not in g.ranks:
+        raise ValueError(f"{api}: {role} rank {rank} is not in group "
+                         f"{g.ranks}")
+    return g.ranks.index(rank)
+
+
 def _check_stacked(arr, g: Group, api: str):
     if arr.ndim == 0 or arr.shape[0] != g.nranks:
         raise ValueError(
@@ -180,7 +188,7 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     g = _group_or_world(group)
     arr = tensor._value()
     _check_stacked(arr, g, "broadcast")
-    src_local = g.get_group_rank(src) if src in g.ranks else src
+    src_local = _group_local(g, src, "broadcast", "src")
 
     def body(s):
         return jax.lax.all_gather(s[0], Group.AXIS)[src_local][None]
@@ -195,7 +203,7 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=Tr
     g = _group_or_world(group)
     arr = tensor._value()
     _check_stacked(arr, g, "reduce")
-    dst_local = g.get_group_rank(dst) if dst in g.ranks else dst
+    dst_local = _group_local(g, dst, "reduce", "dst")
     red = _make_reducer(op, g)
 
     def body(s):
@@ -221,7 +229,7 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None, sync_op=
         src_t = tensor
     arr = src_t._value()
     _check_stacked(arr, g, "scatter")
-    src_local = g.get_group_rank(src) if src in g.ranks else src
+    src_local = _group_local(g, src, "scatter", "src")
 
     def body(s):  # s: [1, W, ...] -> [1, ...] (keepdims keeps the rank dim)
         rows = jax.lax.all_gather(s[0], Group.AXIS)  # [W, W, ...]

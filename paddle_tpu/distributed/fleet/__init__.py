@@ -47,17 +47,18 @@ def init(role_maker=None, is_collective: bool = True,
     hc = strategy.hybrid_configs
     n_dev = len(jax.devices())
     rest = hc.pp_degree * hc.sharding_degree * hc.sep_degree * hc.mp_degree
-    if hc.dp_degree <= 0:  # -1 → infer from device count like the reference
-        hc.dp_degree = max(n_dev // rest, 1)
-    total = hc.dp_degree * rest
-    if total != n_dev:
-        if n_dev % rest == 0:
-            hc.dp_degree = n_dev // rest
-        else:
+    if hc.dp_degree <= 0:  # -1 (default) → infer from the device count,
+        # like the reference's dp_degree=-1 convention
+        if n_dev % rest != 0:
             raise ValueError(
-                f"hybrid degrees dp={hc.dp_degree} pp={hc.pp_degree} "
-                f"sharding={hc.sharding_degree} sep={hc.sep_degree} "
-                f"mp={hc.mp_degree} do not cover {n_dev} devices")
+                f"pp×sharding×sep×mp={rest} does not divide {n_dev} devices")
+        hc.dp_degree = n_dev // rest
+    if hc.dp_degree * rest != n_dev:
+        raise ValueError(
+            f"hybrid degrees dp={hc.dp_degree} pp={hc.pp_degree} "
+            f"sharding={hc.sharding_degree} sep={hc.sep_degree} "
+            f"mp={hc.mp_degree} multiply to {hc.dp_degree * rest}, "
+            f"but there are {n_dev} devices")
     topo = CommunicateTopology(
         ["data", "pipe", "sharding", "sep", "model"],
         [hc.dp_degree, hc.pp_degree, hc.sharding_degree, hc.sep_degree,

@@ -33,8 +33,10 @@ def _shard_accumulators(inner: Optimizer, mesh, enable_zero: bool,
                         zero_axis: str = "sharding"):
     """Wrap inner._get_accumulator so every accumulator is committed to the
     mesh at creation: TP spec inherited from its parameter, plus a
-    `zero_axis` shard when ZeRO is on."""
-    orig = inner._get_accumulator
+    `zero_axis` shard when ZeRO is on.  Re-wrapping (distributed_optimizer
+    then group_sharded_parallel) replaces the policy instead of stacking."""
+    orig = getattr(inner, "_orig_get_accumulator", inner._get_accumulator)
+    inner._orig_get_accumulator = orig
 
     def wrapped(name: str, p: Tensor, init=0.0, dtype=None):
         key = inner._param_key(p)

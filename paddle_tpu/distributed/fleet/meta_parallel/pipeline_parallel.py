@@ -44,7 +44,9 @@ class PipelineParallel(Layer):
             return [t] * max(n, 1)
         arr = t._value()
         if arr.shape[0] % n != 0:
-            return [t] * n
+            raise ValueError(
+                f"batch size {arr.shape[0]} is not divisible by "
+                f"accumulate_steps {n}")
         size = arr.shape[0] // n
         return [Tensor._wrap(arr[i * size:(i + 1) * size],
                              stop_gradient=t.stop_gradient) for i in range(n)]

@@ -85,21 +85,10 @@ class DataParallel(Layer):
             if isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer):
                 p._set_data(jax.device_put(arr, repl))
 
-    def _shard_input(self, t):
-        if not isinstance(t, Tensor):
-            return t
-        arr = t._value()
-        if isinstance(arr, jax.core.Tracer) or arr.ndim == 0:
-            return t
-        n = self._mesh.shape[self._data_axis]
-        if arr.shape[0] % n != 0:
-            return t
-        sh = NamedSharding(self._mesh, P(self._data_axis))
-        return Tensor._wrap(jax.device_put(arr, sh), stop_gradient=t.stop_gradient)
-
     def forward(self, *inputs, **kwargs):
-        inputs = tuple(self._shard_input(x) for x in inputs)
-        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        from .fleet.meta_parallel.tensor_parallel import shard_batch
+        inputs = tuple(shard_batch(x, self._mesh) for x in inputs)
+        kwargs = {k: shard_batch(v, self._mesh) for k, v in kwargs.items()}
         return self._layers(*inputs, **kwargs)
 
     # reference API surface ------------------------------------------------

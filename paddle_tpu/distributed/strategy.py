@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 @dataclass
 class HybridConfig:
-    dp_degree: int = 1
+    dp_degree: int = -1  # -1 → inferred from the device count at fleet.init
     mp_degree: int = 1
     pp_degree: int = 1
     sharding_degree: int = 1

@@ -36,6 +36,7 @@ class Optimizer:
                 weight_decay, "coeff", 0.0)))
         # name → {acc_name: Tensor}
         self._accumulators: Dict[str, Dict[str, Tensor]] = {}
+        self._acc_inits: Dict[tuple, float] = {}
         self._global_step = 0
 
     # -- lr ----------------------------------------------------------------
@@ -90,6 +91,8 @@ class Optimizer:
             # train step must still be persistent program state
             accs[name] = tensor_mod.external_tensor(
                 lambda: jnp.full(shape, init, dtype=dt))
+            # init value kept for skip-step rollback (amp GradScaler)
+            self._acc_inits[(key, name)] = init
         return accs[name]
 
     # -- main entry points ---------------------------------------------------

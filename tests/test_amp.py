@@ -1,0 +1,166 @@
+"""AMP tests: autocast dtype policy + GradScaler dynamic loss scaling."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestAutoCast:
+    def test_white_list_casts_down(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(x, y)
+        assert out._value().dtype == jnp.bfloat16
+        out2 = paddle.matmul(x, y)
+        assert out2._value().dtype == jnp.float32
+
+    def test_black_list_stays_f32(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = F.softmax(x)
+        assert out._value().dtype == jnp.float32
+
+    def test_o1_gray_passthrough(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            out = paddle.add(x, x)
+        assert out._value().dtype == jnp.float32
+
+    def test_custom_lists(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16",
+                                  custom_white_list=["add"]):
+            out = paddle.add(x, x)
+        assert out._value().dtype == jnp.bfloat16
+
+    def test_disable(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        with paddle.amp.auto_cast(enable=False):
+            out = paddle.matmul(x, x.t())
+        assert out._value().dtype == jnp.float32
+
+    def test_grad_flows_through_cast(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32),
+                             stop_gradient=False)
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            loss = paddle.matmul(x, x).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert x.grad._value().dtype == jnp.float32
+
+    def test_o2_gray_casts_down_no_recursion(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O2"):
+            out = paddle.add(x, x)
+        assert out._value().dtype == jnp.bfloat16
+
+    def test_custom_black_overrides_default_white(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16",
+                                  custom_black_list=["matmul"]):
+            out = paddle.matmul(x, x)
+        assert out._value().dtype == jnp.float32
+
+    def test_decorate_o2(self):
+        m = nn.Linear(4, 4)
+        paddle.amp.decorate(m, level="O2", dtype="bfloat16")
+        assert m.weight._value().dtype == jnp.bfloat16
+
+
+class TestGradScaler:
+    def _train(self, scaler, n=3, poison_at=None):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(8, 2).astype(np.float32))
+        for i in range(n):
+            loss = ((m(x) - y) ** 2).mean()
+            scaled = scaler.scale(loss)
+            scaled.backward()
+            if poison_at is not None and i == poison_at:
+                m.weight.grad = np.full((4, 2), np.inf, np.float32)
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+        return m, float(loss)
+
+    def test_scaled_training_matches_unscaled(self):
+        s_on = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        s_off = paddle.amp.GradScaler(enable=False)
+        m1, l1 = self._train(s_on)
+        m2, l2 = self._train(s_off)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-5)
+
+    def test_inf_step_skipped_and_scale_halved(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       decr_every_n_nan_or_inf=1)
+        m, _ = self._train(scaler, n=1, poison_at=0)
+        # the poisoned step must be skipped → scale halved
+        assert scaler.get_loss_scaling() == 512.0
+
+    def test_param_unchanged_on_skip(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       decr_every_n_nan_or_inf=1)
+        m = nn.Linear(4, 2)
+        w0 = m.weight.numpy().copy()
+        opt = paddle.optimizer.Adam(parameters=m.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        loss = m(x).mean()
+        scaler.scale(loss).backward()
+        m.weight.grad = np.full((4, 2), np.nan, np.float32)
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(m.weight.numpy(), w0)
+        # adam moments rolled back to init
+        accs = opt._accumulators[next(iter(opt._accumulators))]
+        np.testing.assert_allclose(accs["moment1"].numpy(), 0.0)
+        np.testing.assert_allclose(accs["beta1_pow"].numpy(), 1.0)
+
+    def test_scale_grows_after_n_good_steps(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       incr_every_n_steps=2)
+        self._train(scaler, n=4)
+        assert scaler.get_loss_scaling() == 32.0
+
+    def test_double_unscale_raises(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(parameters=m.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        scaler.scale(m(x).mean()).backward()
+        scaler.unscale_(opt)
+        with pytest.raises(RuntimeError):
+            scaler.unscale_(opt)
+
+    def test_jitted_step_with_scaler(self):
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=256.0)
+
+        @paddle.jit.to_static
+        def step(x, y):
+            with paddle.amp.auto_cast(dtype="bfloat16"):
+                loss = ((m(x) - y) ** 2).mean()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            return loss
+
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(8, 2).astype(np.float32))
+        l0 = float(step(x, y))
+        for _ in range(10):
+            ln = float(step(x, y))
+        assert ln < l0

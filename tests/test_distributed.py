@@ -160,6 +160,18 @@ class TestTopology:
                                    [2, 2, 1, 1, 2])
         assert topo.get_axis_list("data", 0) == [0, 1, 2, 3]
 
+    def test_broadcast_src_outside_group_raises(self):
+        g = dist.new_group([2, 3])
+        t = paddle.to_tensor(np.ones((2, 1), np.float32))
+        with pytest.raises(ValueError):
+            dist.broadcast(t, src=0, group=g)
+
+    def test_init_degree_mismatch_raises(self):
+        s = dist.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 3, "mp_degree": 2}
+        with pytest.raises(ValueError):
+            fleet.init(is_collective=True, strategy=s)
+
     def test_check_group_cartesian(self):
         topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
                                    [2, 1, 2, 1, 2])
