@@ -129,7 +129,9 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
     cast float params to the low dtype; optimizers keep f32 master state
     (our optimizer accumulators are f32 already — multi_precision default).
     """
-    if level == "O1":
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    if level in ("O0", "O1"):
         return (models, optimizers) if optimizers is not None else models
     target = _LOW[dtype]
     model_list = models if isinstance(models, (list, tuple)) else [models]

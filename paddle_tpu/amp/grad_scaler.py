@@ -164,6 +164,12 @@ class GradScaler:
         self._bad_t._data = jnp.int32(jnp.asarray(sd.get("decr_count", 0)))
         self._incr_ratio = float(sd.get("incr_ratio", self._incr_ratio))
         self._decr_ratio = float(sd.get("decr_ratio", self._decr_ratio))
+        self._incr_every_n_steps = int(
+            sd.get("incr_every_n_steps", self._incr_every_n_steps))
+        self._decr_every_n_nan_or_inf = int(
+            sd.get("decr_every_n_nan_or_inf", self._decr_every_n_nan_or_inf))
+        if "use_dynamic_loss_scaling" in sd:
+            self._use_dynamic = bool(sd["use_dynamic_loss_scaling"]) and self._enable
 
 
 AmpScaler = GradScaler  # legacy alias (fluid/dygraph/amp/loss_scaler.py:40)

@@ -44,6 +44,10 @@ class Tensor:
         "trainable",
         "_version",
         "_backward_hooks",
+        # trace-local tags, owner-checked by jit.trace.TraceHook (object
+        # identity, never id() — ids of dead tensors get reused)
+        "_trace_born",
+        "_trace_grad",
         "__weakref__",
     )
 
@@ -63,6 +67,8 @@ class Tensor:
         self.trainable = True
         self._version = 0
         self._backward_hooks = None
+        self._trace_born = None
+        self._trace_grad = None
         h = _trace_hook
         if h is not None:
             h.mark_created(self)
@@ -79,6 +85,8 @@ class Tensor:
         t.trainable = True
         t._version = 0
         t._backward_hooks = None
+        t._trace_born = None
+        t._trace_grad = None
         h = _trace_hook
         if h is not None:
             h.mark_created(t)
@@ -413,4 +421,6 @@ def external_tensor(value, dtype=None) -> Tensor:
     t.trainable = False
     t._version = 0
     t._backward_hooks = None
+    t._trace_born = None
+    t._trace_grad = None
     return t

@@ -43,8 +43,9 @@ def place_parameters(layer: Layer, mesh=None, zero_params: bool = False,
     return layer
 
 
-def shard_batch(t, mesh=None, seq_dim=None):
-    """Place one input tensor: dim0 over (data, sharding), seq_dim over sep."""
+def shard_batch(t, mesh=None, seq_dim=None, batch_axes=BATCH_AXES):
+    """Place one input tensor: dim0 over `batch_axes` (default
+    data+sharding), seq_dim over sep."""
     if not isinstance(t, Tensor):
         return t
     m = mesh or mesh_mod.get_global_mesh()
@@ -52,7 +53,7 @@ def shard_batch(t, mesh=None, seq_dim=None):
     if m is None or isinstance(arr, jax.core.Tracer) or arr.ndim == 0:
         return t
     entries = [None] * arr.ndim
-    entries[0] = tuple(a for a in BATCH_AXES if m.shape.get(a, 1) > 1) or None
+    entries[0] = tuple(a for a in batch_axes if m.shape.get(a, 1) > 1) or None
     if seq_dim is not None and arr.ndim > seq_dim and m.shape.get(SEQ_AXIS, 1) > 1:
         entries[seq_dim] = SEQ_AXIS
     spec = P(*entries)

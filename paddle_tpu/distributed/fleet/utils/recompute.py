@@ -53,19 +53,31 @@ def recompute(function, *args, preserve_rng_state: bool = True,
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     tensor_args = [args[i] for i in tensor_idx]
     n_args = len(tensor_args)
-    out_struct = {}
+
+    # One fresh key per region: the region's random ops (dropout) split from
+    # it inside the remat'd function, so forward and backward-replay see the
+    # same stream (the reference saves/restores RNG state by hand,
+    # recompute.py:84) and regions stay mutually independent.  The key is an
+    # explicit remat input — the global generator state is never written
+    # from inside the traced region (that would leak a tracer).
+    from ....core import rng as rng_mod
+    region_key = Tensor._wrap(jax.random.key_data(rng_mod.next_key()))
+    gen_state = rng_mod.default_generator()._state
 
     def _pure(*arrays):
         arg_arrays = arrays[:n_args]
-        ext_arrays = arrays[n_args:]
+        ext_arrays = arrays[n_args:-1]
+        key_arr = arrays[-1]
         call_args = list(args)
         for j, i in enumerate(tensor_idx):
             call_args[i] = Tensor._wrap(arg_arrays[j],
                                         stop_gradient=args[i].stop_gradient)
         saved = [(t, t._data) for t in externals]
+        saved_state = gen_state._data
         try:
             for t, a in zip(externals, ext_arrays):
                 t._data = a
+            gen_state._data = key_arr
             # the outer jax.vjp differentiates this whole pure fn; the inner
             # tape would be redundant work, so record nothing inside
             with autograd.no_grad():
@@ -73,14 +85,13 @@ def recompute(function, *args, preserve_rng_state: bool = True,
         finally:
             for t, a in saved:
                 t._data = a
+            gen_state._data = saved_state
         if isinstance(out, (tuple, list)):
-            out_struct["n"] = len(out)
             return tuple(o._value() if isinstance(o, Tensor) else o for o in out)
-        out_struct["n"] = 1
         return out._value() if isinstance(out, Tensor) else out
 
     remat_fn = jax.checkpoint(_pure)
-    all_inputs = tensor_args + list(externals)
+    all_inputs = tensor_args + list(externals) + [region_key]
     out = apply_op("recompute", remat_fn, all_inputs, n_outs=1)
     # apply_op wraps tuple outputs automatically when primal returns a tuple
     return out

@@ -87,8 +87,11 @@ class DataParallel(Layer):
 
     def forward(self, *inputs, **kwargs):
         from .fleet.meta_parallel.tensor_parallel import shard_batch
-        inputs = tuple(shard_batch(x, self._mesh) for x in inputs)
-        kwargs = {k: shard_batch(v, self._mesh) for k, v in kwargs.items()}
+        axes = (self._data_axis, "sharding")
+        inputs = tuple(shard_batch(x, self._mesh, batch_axes=axes)
+                       for x in inputs)
+        kwargs = {k: shard_batch(v, self._mesh, batch_axes=axes)
+                  for k, v in kwargs.items()}
         return self._layers(*inputs, **kwargs)
 
     # reference API surface ------------------------------------------------

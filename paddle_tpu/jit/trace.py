@@ -82,18 +82,28 @@ class TraceHook:
         self.new_found: List[_StateKey] = []
         self.writes: Dict[_StateKey, Any] = {}
         self.grad_none: set = set()  # grads structurally absent this trace
-        self.created: set = set()  # id()s of tensors born inside this trace
-        self.local_grads: Dict[int, Any] = {}  # grads of trace-local tensors
         self.performed_backward = False  # any non-None grad write seen
 
+    # Trace-local bookkeeping lives ON the tensor (owner-tagged slots), not
+    # in id()-keyed sets: a GC'd trace-local tensor's id can be reused by a
+    # brand-new external tensor, which an id set would misclassify as local.
     def mark_created(self, t):
-        self.created.add(id(t))
+        t._trace_born = self
 
     def unmark_created(self, t):
-        self.created.discard(id(t))
+        t._trace_born = None
 
     def _is_local(self, t) -> bool:
-        return id(t) in self.created or _is_tracer(t._data)
+        return t._trace_born is self or _is_tracer(t._data)
+
+    def _local_grad(self, t):
+        lg = t._trace_grad
+        if lg is not None and lg[0] is self:
+            return lg[1]
+        return t._grad
+
+    def _set_local_grad(self, t, arr):
+        t._trace_grad = (self, arr)
 
     def read(self, t: Tensor):
         if self._is_local(t):
@@ -128,7 +138,7 @@ class TraceHook:
     def read_grad(self, t: Tensor):
         """Structural read (Tensor.grad property): absent grad stays None."""
         if self._is_local(t):
-            return self.local_grads.get(id(t), t._grad)
+            return self._local_grad(t)
         key = _StateKey(t, "grad")
         v, hit = self._grad_key_lookup(key)
         if hit:
@@ -147,7 +157,7 @@ class TraceHook:
         """Accumulation read: lift a zeros-backed input so fresh-grad and
         accumulate-grad calls share one program structure."""
         if self._is_local(t):
-            return self.local_grads.get(id(t), t._grad)
+            return self._local_grad(t)
         key = _StateKey(t, "grad")
         v, hit = self._grad_key_lookup(key)
         if hit:
@@ -163,7 +173,7 @@ class TraceHook:
         if arr is not None:
             self.performed_backward = True
         if self._is_local(t):
-            self.local_grads[id(t)] = arr
+            self._set_local_grad(t, arr)
             return
         key = _StateKey(t, "grad")
         if arr is None:
@@ -300,7 +310,30 @@ class CompiledProgram:
         else:
             raise RuntimeError("to_static: state discovery did not converge")
 
-        def program(aa, sa):
+        # Buffer donation: data-kind state leaves that are rewritten every
+        # call (params, optimizer moments, RNG state) alias their outputs,
+        # so the executable updates them in place — without this, a train
+        # step holds two copies of every parameter and moment (the
+        # reference gets the same effect from inplace ops + buffer-share
+        # passes).  Grad-kind leaves are NOT donated: `p.grad` hands out
+        # aliases of the raw buffer and a later donated call would
+        # invalidate them.  Caveat (shared with torch inplace optimizers):
+        # a _value()/state_dict alias of a *parameter* captured before a
+        # compiled train step is invalidated by that step's donation.
+        replaced = {
+            k for k, none in zip(self.write_keys, self.write_none_mask)
+            if not none and k.kind == "data"}
+        self._don_idx = [i for i, k in enumerate(self.state_keys)
+                         if k in replaced]
+        self._keep_idx = [i for i, k in enumerate(self.state_keys)
+                          if k not in replaced]
+
+        def program(aa, sd, sk):
+            sa = [None] * len(self.state_keys)
+            for j, i in enumerate(self._don_idx):
+                sa[i] = sd[j]
+            for j, i in enumerate(self._keep_idx):
+                sa[i] = sk[j]
             hook, _, out_arrays = self._run_traced(aa, sa)
             write_arrays = []
             for k, none_at_build in zip(self.write_keys, self.write_none_mask):
@@ -312,8 +345,16 @@ class CompiledProgram:
                     write_arrays.append(w)
             return tuple(out_arrays), tuple(write_arrays)
 
+        # donating variant for the state-mutating fast path; non-donating
+        # for the differentiable path (vjp residuals may alias state bufs)
         self.jitted = jax.jit(program)
+        self.jitted_donate = jax.jit(program, donate_argnums=(1,))
         return self
+
+    def _split_state(self, state_arrays):
+        sd = [state_arrays[i] for i in self._don_idx]
+        sk = [state_arrays[i] for i in self._keep_idx]
+        return sd, sk
 
     def _writeback(self, write_arrays):
         for k, none_at_build, arr in zip(
@@ -335,7 +376,8 @@ class CompiledProgram:
                         for k in self.state_keys))
         )
         if not outer_diff:
-            out_arrays, write_arrays = self.jitted(arg_arrays, state_arrays)
+            sd, sk = self._split_state(state_arrays)
+            out_arrays, write_arrays = self.jitted_donate(arg_arrays, sd, sk)
             self._writeback(write_arrays)
             out_leaves = [Tensor._wrap(a) for a in out_arrays]
             return _unflatten_io(self.out_tree, out_leaves)
@@ -354,7 +396,8 @@ class CompiledProgram:
         def primal(*arrays):
             aa = list(arrays[:n_args])
             sa = list(arrays[n_args:])
-            out_arrays, write_arrays = self.jitted(aa, sa)
+            sd, sk = self._split_state(sa)
+            out_arrays, write_arrays = self.jitted(aa, sd, sk)
             flat = tuple(out_arrays) + tuple(write_arrays)
             return flat[0] if len(flat) == 1 else flat
 

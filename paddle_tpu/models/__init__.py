@@ -1,0 +1,12 @@
+"""Flagship model families (TPU-native, hybrid-parallel-ready).
+
+The reference ships its large-model definitions in test/benchmark harnesses
+(auto_parallel_gpt_model.py, hybrid_parallel_pp_transformer.py) and fused
+transformer ops (operators/fused/).  Here they are first-class: every model
+is built from the parallel layers in distributed.fleet.meta_parallel, so
+the same definition runs single-chip or on any hybrid mesh.
+"""
+from .gpt import (
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    GPT_CONFIGS, gpt_tiny, gpt2_345m, gpt3_13b,
+)

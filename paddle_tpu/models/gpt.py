@@ -1,0 +1,252 @@
+"""GPT decoder-only transformer — the flagship model family.
+
+Reference parity: the GPT used across the reference's hybrid-parallel and
+auto-parallel tests (unittests/auto_parallel_gpt_model.py; fused kernels
+operators/fused/fused_attention_op.cu, fused_feedforward_op) and the
+Megatron construction of mp_layers.py.
+
+TPU-native design decisions:
+- Q/K/V is ONE ColumnParallelLinear of width 3*hidden whose output dim is
+  laid out head-major [n_heads, 3*head_dim]: after reshape the sharded dim
+  lands on n_heads, so GSPMD keeps heads on the "model" axis through the
+  whole attention block with zero resharding (a fused-qkv layout the
+  reference implements inside fused_attention with per-rank slicing).
+- Attention runs through ops.pallas.flash_attention (Pallas kernel on TPU,
+  XLA oracle elsewhere); is_causal=True, no materialized [S,S] mask.
+- Sequence dim carries the "sep" axis (context parallelism — capability
+  beyond the reference, SURVEY.md §5.7).
+- Activation recompute per decoder layer via fleet recompute
+  (jax.checkpoint) when config.recompute is on.
+- LM head ties the vocab-parallel embedding weight (SharedLayerDesc
+  semantics without the grad-sync machinery: one parameter object).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import LayerNorm
+from ..ops import pallas
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from ..distributed.fleet.utils.recompute import recompute
+from ..distributed.sharding_spec import (
+    BATCH_AXES, MODEL_AXIS, SEQ_AXIS, mark_sharding, set_param_spec,
+)
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    recompute: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0, **kw)
+
+
+def gpt2_345m(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=50304, hidden_size=1024,
+                     num_hidden_layers=24, num_attention_heads=16,
+                     max_position_embeddings=1024, **kw)
+
+
+def gpt3_13b(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=50304, hidden_size=5120,
+                     num_hidden_layers=40, num_attention_heads=40,
+                     max_position_embeddings=2048, **kw)
+
+
+GPT_CONFIGS = {"tiny": gpt_tiny, "gpt2-345m": gpt2_345m, "gpt3-13b": gpt3_13b}
+
+
+def _act_spec(last=None):
+    return P(BATCH_AXES, SEQ_AXIS, last)
+
+
+class GPTAttention(Layer):
+    """Causal self-attention, heads sharded over the model axis."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.n_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        h = config.hidden_size
+        init = I.Normal(std=config.initializer_range)
+        # fused qkv, head-major output layout [n_heads, 3*head_dim]
+        self.qkv_proj = ColumnParallelLinear(
+            h, 3 * h, weight_attr=init, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            h, h, weight_attr=init, input_is_parallel=True)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x):
+        B, S, _ = x.shape
+        qkv = self.qkv_proj(x)                                  # [B,S,3h]/mp
+        qkv = qkv.reshape([B, S, self.n_heads, 3 * self.head_dim])
+        qkv = mark_sharding(qkv, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
+        q, k, v = qkv.split(3, axis=-1)                         # [B,S,H,D]
+        ctx = pallas.flash_attention(
+            q, k, v, dropout_p=self.dropout_p, is_causal=True,
+            training=self.training)
+        ctx = mark_sharding(ctx, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
+        ctx = ctx.reshape([B, S, self.n_heads * self.head_dim])
+        return self.out_proj(ctx)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        self.fc1 = ColumnParallelLinear(
+            config.hidden_size, config.ffn_size, weight_attr=init,
+            gather_output=False)
+        self.fc2 = RowParallelLinear(
+            config.ffn_size, config.hidden_size, weight_attr=init,
+            input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN block (reference: fused_attention + fused_feedforward
+    semantics: LN → attn → dropout → residual; LN → mlp → dropout →
+    residual)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        eps = config.layer_norm_epsilon
+        self.ln1 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.attn = GPTAttention(config)
+        self.ln2 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.mlp = GPTMLP(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return mark_sharding(x, _act_spec())
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=init)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            S = input_ids.shape[-1]
+            max_pos = self.position_embeddings._num_embeddings
+            if S > max_pos:
+                raise ValueError(
+                    f"sequence length {S} exceeds max_position_embeddings "
+                    f"{max_pos}")
+            position_ids = Tensor._wrap(jnp.arange(S)[None, :])
+        h = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        return self.dropout(mark_sharding(h, _act_spec()))
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.final_ln = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.embeddings(input_ids, position_ids)
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                h = recompute(layer, h)
+            else:
+                h = layer(h)
+        return self.final_ln(h)
+
+
+class GPTForCausalLM(Layer):
+    """GPTModel + LM head (tied to the vocab-parallel embedding by
+    default)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+            set_param_spec(self.lm_head.weight, P(None, MODEL_AXIS))
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            w = self.gpt.embeddings.word_embeddings.weight
+            logits = h.matmul(w.t())
+        return mark_sharding(logits, _act_spec(last=MODEL_AXIS))
+
+
+class GPTPretrainingCriterion(Layer):
+    """Vocab-parallel causal-LM loss (reference:
+    auto_parallel_gpt_model.py GPTPretrainingCriterion)."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ce = ParallelCrossEntropy(ignore_index=ignore_index)
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = self.ce(logits, labels)          # [B, S, 1]
+        loss = loss.squeeze(-1)
+        if loss_mask is not None:
+            m = loss_mask.astype("float32")
+            return (loss * m).sum() / m.sum().clip(min=1.0)
+        denom = (labels != self.ignore_index).astype("float32").sum()
+        return loss.sum() / denom.clip(min=1.0)

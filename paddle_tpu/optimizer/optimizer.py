@@ -79,14 +79,14 @@ class Optimizer:
         return p.name or f"param_{id(p)}"
 
     def _get_accumulator(self, name: str, p: Tensor, init=0.0,
-                         dtype=None) -> Tensor:
+                         dtype=None, shape=None) -> Tensor:
         key = self._param_key(p)
         accs = self._accumulators.setdefault(key, {})
         if name not in accs:
             from ..core import tensor as tensor_mod
 
             dt = dtype or p._value().dtype
-            shape = tuple(p.shape)
+            shape = tuple(p.shape) if shape is None else tuple(shape)
             # external_tensor: accumulators lazily created inside a traced
             # train step must still be persistent program state
             accs[name] = tensor_mod.external_tensor(
@@ -225,8 +225,8 @@ class Adam(Optimizer):
         lr = self._lr_array()
         m = self._get_accumulator("moment1", p, dtype=jnp.float32)
         v = self._get_accumulator("moment2", p, dtype=jnp.float32)
-        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32)
-        b2p = self._get_accumulator("beta2_pow", p, init=1.0, dtype=jnp.float32)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32, shape=())
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, dtype=jnp.float32, shape=())
         g32 = g.astype(jnp.float32)
         m_new = self._beta1 * m._value() + (1 - self._beta1) * g32
         v_new = self._beta2 * v._value() + (1 - self._beta2) * jnp.square(g32)
@@ -281,7 +281,7 @@ class Adamax(Optimizer):
         lr = self._lr_array()
         m = self._get_accumulator("moment", p, dtype=jnp.float32)
         u = self._get_accumulator("inf_norm", p, dtype=jnp.float32)
-        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32, shape=())
         g32 = g.astype(jnp.float32)
         m_new = self._beta1 * m._value() + (1 - self._beta1) * g32
         u_new = jnp.maximum(self._beta2 * u._value(), jnp.abs(g32))
@@ -375,8 +375,8 @@ class Lamb(Optimizer):
         lr = self._lr_array()
         m = self._get_accumulator("moment1", p, dtype=jnp.float32)
         v = self._get_accumulator("moment2", p, dtype=jnp.float32)
-        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32)
-        b2p = self._get_accumulator("beta2_pow", p, init=1.0, dtype=jnp.float32)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32, shape=())
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, dtype=jnp.float32, shape=())
         g32 = g.astype(jnp.float32)
         m_new = self._beta1 * m._value() + (1 - self._beta1) * g32
         v_new = self._beta2 * v._value() + (1 - self._beta2) * jnp.square(g32)
