@@ -63,12 +63,13 @@ def flash_attention(query, key, value, attn_mask=None, dropout_p=0.0,
     key_arr = rng_mod.next_key() if p > 0.0 else None
 
     if use_pallas() and attn_mask is None and p == 0.0:
-        from .flash_attention import flash_attention_fused
+        from .flash_attention import flash_attention_fused, supports
 
-        def _primal(q, k, v):
-            return flash_attention_fused(q, k, v, causal=is_causal)
+        if supports(tuple(query.shape), tuple(key.shape)):
+            def _primal(q, k, v):
+                return flash_attention_fused(q, k, v, causal=is_causal)
 
-        return apply_op("flash_attention", _primal, [query, key, value])
+            return apply_op("flash_attention", _primal, [query, key, value])
 
     def _primal(q, k, v, *extra):
         i = 0

@@ -1,0 +1,335 @@
+"""Pallas TPU flash attention: fused causal attention fwd + bwd kernels.
+
+Reference parity: fused_attention_op.cu / fmha_ref.h (the reference's
+hand-fused CUDA attention) — re-designed as a blocked online-softmax kernel
+for the MXU (never materializes the [S, S] score matrix in HBM).
+
+Layout: kernels run on [BH, S, D] (batch×heads flattened); the public entry
+takes paddle's fused-attention layout [B, S, H, D].
+
+Forward: grid (BH, S/BQ, S/BK), k-block innermost, f32 running max/sum/acc
+in VMEM scratch; emits O and the logsumexp rows.  Backward: the standard
+two-kernel recomputation from (q, k, v, O, lse, delta=rowsum(dO·O)):
+one accumulating (dk, dv) over q-blocks, one accumulating dq over k-blocks.
+Causal blocks entirely above the diagonal are skipped with pl.when.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _row_ids(iq, ik, block_q, block_k):
+    shape = (block_q, block_k)
+    rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return rows, cols
+
+
+def _scores(q, k, iq, ik, *, scale, causal, block_q, block_k):
+    """Masked scaled scores s = mask(qk^T·scale) in f32 — shared by fwd and
+    both bwd kernels so the mask/scale math cannot diverge."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows, cols = _row_ids(iq, ik, block_q, block_k)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return s
+
+
+def _p_ds(q, k, v, do, lse, delta, iq, ik, *, scale, causal, block_q, block_k):
+    """Recompute (p, ds) for the backward kernels: p = exp(s − lse),
+    ds = p ∘ (dO·vᵀ − delta)·scale."""
+    s = _scores(q, k, iq, ik, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+# -- forward ---------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: the block is live unless it sits entirely above the diagonal
+    live = jnp.logical_or(not causal,
+                          iq * block_q + block_q - 1 >= ik * block_k)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                               # [BQ, D]
+        k = k_ref[0]                               # [BK, D]
+        v = v_ref[0]                               # [BK, D]
+        s = _scores(q, k, iq, ik, scale=scale, causal=causal,
+                    block_q=block_q, block_k=block_k)    # [BQ, BK]
+        m_prev = m_ref[:, 0:1]                     # [BQ, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [BQ, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [BQ, BK]
+        corr = jnp.exp(m_prev - m_new)             # [BQ, 1]
+        l_new = l_ref[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BQ, D]
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0:1] +
+                      jnp.log(jnp.maximum(l, 1e-30)))
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    BH, S, D = q.shape
+    grid = (BH, S // block_q, S // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    out_shape = [
+        jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),  # row-stat layout:
+        # trailing singleton keeps blocks at (BQ, 1), legal TPU tiling
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# -- backward --------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = jnp.logical_or(not causal,
+                          iq * block_q + block_q - 1 >= ik * block_k)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                        # [BQ, 1]
+        delta = delta_ref[0]                    # [BQ, 1]
+        p, ds = _p_ds(q, k, v, do, lse, delta, iq, ik, scale=scale,
+                      causal=causal, block_q=block_q, block_k=block_k)
+        # dv += pᵀ @ dO ; dk += dsᵀ @ q
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = jnp.logical_or(not causal,
+                          iq * block_q + block_q - 1 >= ik * block_k)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                        # [BQ, 1]
+        delta = delta_ref[0]                    # [BQ, 1]
+        _, ds = _p_ds(q, k, v, do, lse, delta, iq, ik, scale=scale,
+                      causal=causal, block_q=block_q, block_k=block_k)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+    q, k, v, o, lse = res
+    do = g
+    BH, S, D = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                     # [BH, S, 1]
+
+    kv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    dk, dv = pl.pallas_call(
+        kv_kernel,
+        grid=(BH, S // block_k, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ik, iq: (bh, ik, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, S // block_q, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# -- public entry (custom_vjp over [B, S, H, D]) ---------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, scale=1.0 / math.sqrt(q.shape[-1]), causal=causal,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, scale=1.0 / math.sqrt(q.shape[-1]), causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    scale = 1.0 / math.sqrt(res[0].shape[-1])
+    return _bwd(res, g, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_fused(q, k, v, causal=True, block_q=None, block_k=None,
+                          interpret=False):
+    """q/k/v: [B, S, H, D] → [B, S, H, D]."""
+    B, S, H, D = q.shape
+    if k.shape[1] != S:
+        raise ValueError(
+            f"flash_attention_fused requires Sq == Sk (self-attention); got "
+            f"q seq {S}, k seq {k.shape[1]} — use the XLA oracle for "
+            f"cross-attention/decode")
+    block_q = block_q or min(DEFAULT_BLOCK_Q, S)
+    block_k = block_k or min(DEFAULT_BLOCK_K, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"sequence {S} must divide block sizes "
+                         f"({block_q}, {block_k})")
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k,
+               interpret)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def supports(q_shape, k_shape, block_q=None, block_k=None) -> bool:
+    """Dispatch guard: shapes this kernel handles (self-attention, block-
+    divisible sequence)."""
+    if len(q_shape) != 4 or len(k_shape) != 4:
+        return False
+    S = q_shape[1]
+    if k_shape[1] != S:
+        return False
+    bq = block_q or min(DEFAULT_BLOCK_Q, S)
+    bk = block_k or min(DEFAULT_BLOCK_K, S)
+    return S % bq == 0 and S % bk == 0

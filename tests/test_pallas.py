@@ -1,0 +1,74 @@
+"""Pallas kernel tests (interpret mode on CPU; numerics vs the XLA oracle,
+the reference's own test strategy for fused ops — SURVEY.md §4 OpTest)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.pallas import _sdpa_reference
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_fused
+
+
+def _qkv(B=2, S=256, H=4, D=64, dtype=jnp.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(B, S, H, D), dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_oracle(self, causal):
+        q, k, v = _qkv()
+        o = flash_attention_fused(q, k, v, causal=causal, interpret=True)
+        ref = _sdpa_reference(q, k, v, None, None, 0.0, causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_oracle(self, causal):
+        q, k, v = _qkv()
+
+        def loss_fa(q, k, v):
+            return (flash_attention_fused(q, k, v, causal=causal,
+                                          interpret=True) * v).sum()
+
+        def loss_ref(q, k, v):
+            return (_sdpa_reference(q, k, v, None, None, 0.0, causal) * v).sum()
+
+        g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)
+
+    def test_nondivisible_seq_raises(self):
+        q, k, v = _qkv(S=100)
+        with pytest.raises(ValueError):
+            flash_attention_fused(q, k, v, block_q=128, block_k=128,
+                                  interpret=True)
+
+    def test_supports_guard(self):
+        from paddle_tpu.ops.pallas.flash_attention import supports
+        assert supports((2, 256, 4, 64), (2, 256, 4, 64))
+        assert not supports((2, 300, 4, 64), (2, 300, 4, 64),
+                            block_q=128, block_k=128)
+        assert not supports((2, 1, 4, 64), (2, 256, 4, 64))  # decode
+
+    def test_cross_attention_raises(self):
+        q, _, _ = _qkv(S=128)
+        _, k, v = _qkv(S=256)
+        with pytest.raises(ValueError):
+            flash_attention_fused(q, k, v, interpret=True)
+
+    def test_small_seq_block_clamp(self):
+        q, k, v = _qkv(S=64)
+        o = flash_attention_fused(q, k, v, causal=True, interpret=True)
+        ref = _sdpa_reference(q, k, v, None, None, 0.0, True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
+
+    def test_bf16(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16)
+        o = flash_attention_fused(q, k, v, causal=True, interpret=True)
+        ref = _sdpa_reference(q, k, v, None, None, 0.0, True)
+        assert o.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(ref, np.float32), atol=3e-2)
