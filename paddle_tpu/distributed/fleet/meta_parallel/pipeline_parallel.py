@@ -5,22 +5,45 @@ Reference parity: PipelineParallel.train_batch / forward_backward_pipeline
 micro-batches, runs the 1F1B schedule over stages, accumulates gradients,
 then steps the optimizer once.
 
-TPU-native design: stages are mesh placements, not processes, so the
-*semantics* of train_batch (grad accumulation over micro-batches + single
-optimizer step + mean loss) are expressed directly; the 1F1B interleave is
-a scheduling concern XLA handles when the per-microbatch step is compiled
-over the "pipe" axis (the compiled scan/ppermute schedule lives in
-pp_schedule.py once stage placement is active).  This engine is correct on
-any mesh and is the train_batch API surface.
+TPU-native design: when the PipelineLayer's body is a uniform layer stack
+(the transformer case — the reference's uniform segmentation assumption,
+pp_layers.py:319), the whole schedule compiles into one XLA program via
+pp_schedule.pipeline_apply: stage-stacked params on the "pipe" mesh axis,
+a lax.scan of compute+ppermute ticks, backward by autodiff.  Prologue
+(embeddings) and epilogue (final LN / head) layers run outside the scan
+under plain GSPMD.  Non-uniform models fall back to a sequential engine
+with python-level microbatch accumulation (still correct on any mesh).
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 from ....core.tensor import Tensor
 from ....nn.layer_base import Layer
 from .parallel_layers.pp_layers import PipelineLayer
 from .tensor_parallel import place_parameters, shard_batch
+from .pp_schedule import (
+    layer_param_leaves, pipeline_apply, structure_signature,
+)
+
+
+def _uniform_run(layers: List) -> tuple:
+    """Longest run of structurally-identical Layers: (start, end)."""
+    sigs = [structure_signature(l) if isinstance(l, Layer) else None
+            for l in layers]
+    best = (0, 0)
+    i = 0
+    while i < len(sigs):
+        if sigs[i] is None:
+            i += 1
+            continue
+        j = i
+        while j < len(sigs) and sigs[j] == sigs[i]:
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    return best
 
 
 class PipelineParallel(Layer):
@@ -34,10 +57,37 @@ class PipelineParallel(Layer):
         pcfg = strategy.pipeline_configs if strategy is not None else None
         self.accumulate_steps = pcfg.accumulate_steps if pcfg else 1
         self.micro_batch_size = pcfg.micro_batch_size if pcfg else 1
+        self.num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+
+        body = layers.run_function
+        start, end = _uniform_run(body)
+        run_len = end - start
+        self._use_schedule = (
+            self.num_stages > 1 and run_len >= self.num_stages
+            and run_len % self.num_stages == 0)
+        if self._use_schedule:
+            self._prologue = body[:start]
+            self._body = body[start:end]
+            self._epilogue = body[end:]
+            self._template = self._body[0]
+            self._body_leaves = [layer_param_leaves(l) for l in self._body]
         place_parameters(layers, hcg.mesh if hcg else None)
 
+    # -- forward ------------------------------------------------------------
+
     def forward(self, *args, **kwargs):
-        return self._layers(*args, **kwargs)
+        if not self._use_schedule:
+            return self._layers(*args, **kwargs)
+        x = args[0]
+        x = shard_batch(x, self._hcg.mesh if self._hcg else None)
+        for l in self._prologue:
+            x = l(x)
+        n_micro = max(self.accumulate_steps, 1)
+        x = pipeline_apply(self._template, self._body_leaves, x,
+                           self.num_stages, n_micro, self._hcg.mesh)
+        for l in self._epilogue:
+            x = l(x)
+        return x
 
     def _split_micro(self, t: Tensor, n: int):
         if not isinstance(t, Tensor) or n <= 1:
@@ -51,21 +101,38 @@ class PipelineParallel(Layer):
         return [Tensor._wrap(arr[i * size:(i + 1) * size],
                              stop_gradient=t.stop_gradient) for i in range(n)]
 
+    def _loss(self, out, labels):
+        if self._layers._loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        loss = self._layers._loss_fn(out, labels)
+        if hasattr(loss, "mean") and loss.ndim > 0:
+            loss = loss.mean()
+        return loss
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Reference: pipeline_parallel.py:154 — returns the mean micro loss."""
         inputs, labels = data
+        if self._use_schedule:
+            # microbatching happens inside the compiled scan; one fwd/bwd
+            loss = self._loss(self.forward(inputs), labels)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+                scaler.step(optimizer)
+                scaler.update()
+            else:
+                loss.backward()
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
         n = max(self.accumulate_steps, 1)
         micro_x = self._split_micro(inputs, n)
         micro_y = self._split_micro(labels, n)
         total = None
         for mx, my in zip(micro_x, micro_y):
             mx = shard_batch(mx, self._hcg.mesh if self._hcg else None)
-            out = self._layers(mx)
-            if self._layers._loss_fn is None:
-                raise ValueError("PipelineLayer needs loss_fn for train_batch")
-            loss = self._layers._loss_fn(out, my)
-            if hasattr(loss, "mean") and loss.ndim > 0:
-                loss = loss.mean()
+            loss = self._loss(self._layers(mx), my)
             scaled = loss / n  # grads accumulate over micro-batches
             if scaler is not None:
                 scaler.scale(scaled).backward()
@@ -84,7 +151,7 @@ class PipelineParallel(Layer):
 
     def eval_batch(self, data, compute_loss=True):
         inputs, labels = data
-        out = self._layers(inputs)
+        out = self.forward(inputs)
         if compute_loss and self._layers._loss_fn is not None:
             loss = self._layers._loss_fn(out, labels)
             return loss.mean() if hasattr(loss, "mean") and loss.ndim > 0 else loss

@@ -1,0 +1,152 @@
+"""Compiled pipeline schedule: fill-drain microbatch pipeline as ONE XLA
+program over the "pipe" mesh axis.
+
+Reference parity: PipelineParallel.forward_backward_pipeline
+(fleet/meta_parallel/pipeline_parallel.py:82) — startup/steady/cooldown
+loops exchanging activations over send_v2/recv_v2 between stage processes.
+
+TPU-native design (SURVEY.md §7 "hard parts"): there are no stage
+processes.  The decoder stack's per-layer parameters are stacked to
+[n_stages, layers_per_stage, ...] and sharded over "pipe"; a
+`shard_map` manual only on the pipe axis runs a `lax.scan` over
+M + P − 1 ticks, each tick applying the stage's layers and rotating
+activations with `lax.ppermute` (the ICI-native p2p replacing
+send_v2/recv_v2).  TP/DP/ZeRO axes stay *auto* — GSPMD partitions inside
+the pipeline body, so mp×pp×dp×sharding compose in one program.  The
+backward pipeline is jax.vjp of the scan: reverse ppermutes fall out of
+autodiff instead of a hand-written 1F1B cooldown, and remat bounds
+activation memory the way 1F1B's schedule does.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ....core import autograd
+from ....core import rng as rng_mod
+from ....core.dispatch import apply_op
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+
+
+def layer_param_leaves(layer: Layer) -> List[Tensor]:
+    """Deterministic leaf order: parameters then buffers, name-sorted."""
+    leaves = [p for _, p in sorted(layer.named_parameters())]
+    leaves += [b for _, b in sorted(layer.named_buffers())]
+    return leaves
+
+
+def structure_signature(layer: Layer):
+    return tuple((name, tuple(t.shape), str(t.dtype))
+                 for name, t in sorted(layer.named_parameters())) + \
+        tuple((name, tuple(t.shape), str(t.dtype))
+              for name, t in sorted(layer.named_buffers()))
+
+
+def _template_apply(template: Layer, leaf_arrays, x_arr):
+    """Run template.forward on raw arrays via payload swap (tape off: the
+    pipeline primal is differentiated as one op)."""
+    leaves = layer_param_leaves(template)
+    saved = [(t, t._data) for t in leaves]
+    try:
+        for t, a in zip(leaves, leaf_arrays):
+            t._data = a
+        with autograd.no_grad():
+            out = template(Tensor._wrap(x_arr))
+    finally:
+        for t, a in saved:
+            t._data = a
+    return out._value() if isinstance(out, Tensor) else out
+
+
+def pipeline_apply(template: Layer, per_layer_leaves: Sequence[Sequence[Tensor]],
+                   x: Tensor, n_stages: int, n_micro: int, mesh) -> Tensor:
+    """Run the uniform layer stack over the pipe axis.
+
+    per_layer_leaves: [n_layers][n_leaf] framework Tensors (the real
+    Parameters — their .grad receives the pipeline's backward).
+    x: [B, ...] activations entering the stack.  B must divide n_micro.
+    """
+    n_layers = len(per_layer_leaves)
+    n_leaf = len(per_layer_leaves[0])
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers do not divide {n_stages} stages")
+    k_per_stage = n_layers // n_stages
+    flat_params: List[Tensor] = [t for layer in per_layer_leaves for t in layer]
+
+    gen_state = rng_mod.default_generator()._state
+    region_key = Tensor._wrap(jax.random.key_data(rng_mod.next_key()))
+
+    def primal(x_arr, key_arr, *leaf_arrays):
+        B = x_arr.shape[0]
+        if B % n_micro:
+            raise ValueError(f"batch {B} does not divide {n_micro} microbatches")
+        mb = B // n_micro
+        xs = x_arr.reshape((n_micro, mb) + x_arr.shape[1:])
+        # stack layer leaves → [n_stages, k_per_stage, ...] sharded on pipe
+        stacked = []
+        for j in range(n_leaf):
+            s = jnp.stack([leaf_arrays[i * n_leaf + j]
+                           for i in range(n_layers)], axis=0)
+            s = s.reshape((n_stages, k_per_stage) + s.shape[1:])
+            stacked.append(s)
+
+        def inner(key_l, xs_full, *stacked_local):
+            stage = jax.lax.axis_index("pipe")
+            pad = jnp.zeros((n_stages - 1,) + xs_full.shape[1:],
+                            xs_full.dtype)
+            ticks = jnp.concatenate([xs_full, pad], axis=0)
+            state0 = jnp.zeros(xs_full.shape[1:], xs_full.dtype)
+            # the carry becomes pipe-varying after the first ppermute; its
+            # initial value must carry the same vma type for scan
+            state0 = jax.lax.pvary(state0, ("pipe",))
+
+            def stage_fn(x_in, t):
+                y = x_in
+                saved_state = gen_state._data
+                try:
+                    for k in range(k_per_stage):
+                        arrs = [lv[0, k] for lv in stacked_local]
+                        # per-(tick, local-layer) RNG stream for dropout
+                        kk = jax.random.fold_in(
+                            jax.random.wrap_key_data(key_l),
+                            t * n_layers + stage * k_per_stage + k)
+                        gen_state._data = jax.random.key_data(kk)
+                        y = _template_apply(template, arrs, y)
+                finally:
+                    gen_state._data = saved_state
+                return y
+
+            # remat each stage body: the scan otherwise keeps every tick's
+            # intermediate activations live (1F1B's memory bound, the
+            # reference's recompute_interval in PP)
+            stage_fn_ck = jax.checkpoint(stage_fn)
+
+            def tick(carry, inp):
+                state, t = carry
+                x_in = jnp.where(stage == 0, inp, state)
+                y = stage_fn_ck(x_in, t)
+                perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+                shifted = jax.lax.ppermute(y, "pipe", perm)
+                # only the last stage's y is pipeline output
+                out_t = jnp.where(stage == n_stages - 1, y,
+                                  jnp.zeros_like(y))
+                return (shifted, t + 1), out_t
+
+            (_, _), ys = jax.lax.scan(tick, (state0, jnp.int32(0)), ticks)
+            ys = ys[n_stages - 1:]                       # drop fill ticks
+            return jax.lax.psum(ys, "pipe")              # replicate output
+
+        in_specs = (P(), P()) + tuple(P("pipe") for _ in range(n_leaf))
+        inner_f = shard_map(
+            inner, mesh=mesh, in_specs=in_specs, out_specs=P(),
+            axis_names={"pipe"})
+        ys = inner_f(key_arr, xs, *stacked)
+        return ys.reshape((B,) + ys.shape[2:])
+
+    return apply_op("pipeline_1f1b", primal,
+                    [x, region_key] + flat_params)

@@ -102,6 +102,26 @@ def mark_sharding(t: Tensor, spec: P, mesh: Optional[Mesh] = None) -> Tensor:
 
     def _primal(a):
         if isinstance(a, jax.core.Tracer):
+            # inside a partial-manual shard_map (pipeline body) the global
+            # Mesh's axis types disagree with the trace context; rebuild the
+            # constraint on the current abstract mesh, dropping axes that
+            # are manual there
+            try:
+                am = jax.sharding.get_abstract_mesh()
+            except Exception:
+                am = None
+            if am is not None and getattr(am, "shape_tuple", None):
+                manual = {n for n, t in zip(am.axis_names, am.axis_types)
+                          if "Manual" in str(t)}
+                if manual:
+                    entries = []
+                    for e in spec:
+                        axes = e if isinstance(e, tuple) else (e,)
+                        kept = tuple(a2 for a2 in axes
+                                     if a2 is not None and a2 not in manual)
+                        entries.append(kept if kept else None)
+                    return jax.lax.with_sharding_constraint(
+                        a, NamedSharding(am, P(*entries)))
             return jax.lax.with_sharding_constraint(a, ns)
         return jax.device_put(a, ns)
 

@@ -7,6 +7,6 @@ is built from the parallel layers in distributed.fleet.meta_parallel, so
 the same definition runs single-chip or on any hybrid mesh.
 """
 from .gpt import (
-    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
-    GPT_CONFIGS, gpt_tiny, gpt2_345m, gpt3_13b,
+    GPTConfig, GPTModel, GPTForCausalLM, GPTForCausalLMPipe,
+    GPTPretrainingCriterion, GPT_CONFIGS, gpt_tiny, gpt2_345m, gpt3_13b,
 )

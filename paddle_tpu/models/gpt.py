@@ -233,6 +233,54 @@ class GPTForCausalLM(Layer):
         return mark_sharding(logits, _act_spec(last=MODEL_AXIS))
 
 
+class _GPTHeadPipe(Layer):
+    """Final LN + LM head for the pipelined model.  The tied embedding
+    weight is referenced without sublayer registration (single-controller
+    sharing — SharedLayerDesc semantics, pp_layers.py:77)."""
+
+    def __init__(self, config: GPTConfig, word_embeddings=None):
+        super().__init__()
+        self.final_ln = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_epsilon)
+        if word_embeddings is None:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+            set_param_spec(self.lm_head.weight, P(None, MODEL_AXIS))
+        else:
+            self.lm_head = None
+            object.__setattr__(self, "_tied_embeddings", word_embeddings)
+
+    def forward(self, x):
+        h = self.final_ln(x)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = h.matmul(self._tied_embeddings.weight.t())
+        return mark_sharding(logits, _act_spec(last=MODEL_AXIS))
+
+
+def GPTForCausalLMPipe(config: GPTConfig, topology=None,
+                       num_stages: Optional[int] = None,
+                       recompute_interval: int = 0):
+    """Pipeline-parallel GPT (reference: the GPTForCausalLMPipe pattern of
+    hybrid_parallel_pp_transformer.py) — a PipelineLayer whose uniform
+    decoder stack compiles onto the "pipe" mesh axis."""
+    from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+        PipelineLayer,
+    )
+    emb = GPTEmbeddings(config)
+    layers = [emb]
+    layers += [GPTDecoderLayer(config)
+               for _ in range(config.num_hidden_layers)]
+    tied = emb.word_embeddings if config.tie_word_embeddings else None
+    layers.append(_GPTHeadPipe(config, tied))
+    crit = GPTPretrainingCriterion()
+    return PipelineLayer(
+        layers, num_stages=num_stages, topology=topology,
+        loss_fn=lambda logits, labels: crit(logits, labels),
+        recompute_interval=recompute_interval)
+
+
 class GPTPretrainingCriterion(Layer):
     """Vocab-parallel causal-LM loss (reference:
     auto_parallel_gpt_model.py GPTPretrainingCriterion)."""

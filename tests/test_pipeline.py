@@ -1,0 +1,112 @@
+"""Pipeline-parallel tests: compiled schedule vs sequential execution
+(the reference's PP loss-equivalence strategy, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+
+@pytest.fixture(scope="module")
+def hybrid_pp():
+    s = paddle.distributed.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    s.pipeline_configs = {"accumulate_steps": 4}
+    fleet.init(is_collective=True, strategy=s)
+    return fleet.get_hybrid_communicate_group(), s
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        mp = fleet.meta_parallel
+        self.fc1 = mp.ColumnParallelLinear(16, 32, gather_output=False)
+        self.fc2 = mp.RowParallelLinear(32, 16, input_is_parallel=True)
+        self.ln = nn.LayerNorm(16)
+
+    def forward(self, x):
+        return self.ln(x + self.fc2(F.gelu(self.fc1(x))))
+
+
+def _loss(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _build(hybrid_pp):
+    hcg, _ = hybrid_pp
+    paddle.seed(0)
+    pipe = PipelineLayer(
+        [nn.Linear(8, 16)] + [LayerDesc(Block) for _ in range(4)]
+        + [nn.Linear(16, 4)],
+        topology=hcg.topology(), loss_fn=_loss)
+    return pipe, fleet.distributed_model(pipe)
+
+
+class TestPipelineSchedule:
+    def test_uniform_run_detected(self, hybrid_pp):
+        pipe, model = _build(hybrid_pp)
+        assert model._use_schedule
+        assert len(model._prologue) == 1 and len(model._epilogue) == 1
+        assert len(model._body) == 4
+
+    def test_forward_matches_sequential(self, hybrid_pp):
+        pipe, model = _build(hybrid_pp)
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        np.testing.assert_allclose(model(x).numpy(), pipe(x).numpy(),
+                                   atol=1e-5)
+
+    def test_grads_match_sequential(self, hybrid_pp):
+        pipe, model = _build(hybrid_pp)
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+        _loss(model(x), y).backward()
+        g_pipe = {n: p.grad.numpy().copy()
+                  for n, p in pipe.named_parameters()}
+        for p in pipe.parameters():
+            p.clear_grad()
+        _loss(pipe(x), y).backward()
+        for n, p in pipe.named_parameters():
+            np.testing.assert_allclose(g_pipe[n], p.grad.numpy(), atol=1e-5)
+
+    def test_train_batch_converges_jitted(self, hybrid_pp):
+        pipe, model = _build(hybrid_pp)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=model.parameters()))
+        rs = np.random.RandomState(2)
+        x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+
+        @paddle.jit.to_static
+        def step(x, y):
+            return model.train_batch((x, y), opt)
+
+        l0 = float(step(x, y))
+        for _ in range(10):
+            ln = float(step(x, y))
+        assert np.isfinite(ln) and ln < l0
+
+    def test_micro_batch_indivisible_raises(self, hybrid_pp):
+        pipe, model = _build(hybrid_pp)
+        rs = np.random.RandomState(3)
+        x = paddle.to_tensor(rs.randn(6, 8).astype(np.float32))  # 6 % 4 != 0
+        with pytest.raises(ValueError):
+            model(x)
+
+    def test_gpt_pipe_model(self, hybrid_pp):
+        hcg, _ = hybrid_pp
+        from paddle_tpu.models import gpt_tiny, GPTForCausalLMPipe
+        paddle.seed(0)
+        cfg = gpt_tiny()
+        pipe = GPTForCausalLMPipe(cfg, topology=hcg.topology())
+        model = fleet.distributed_model(pipe)
+        assert model._use_schedule
+        rs = np.random.RandomState(4)
+        x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (8, 16)))
+        np.testing.assert_allclose(model(x).numpy(), pipe(x).numpy(),
+                                   atol=2e-5)
