@@ -37,7 +37,7 @@ from ..nn.layer_base import Layer
 from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.norm import LayerNorm
-from ..ops import pallas
+from ..ops.pallas import flash_attention as _flash_attention
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
@@ -120,7 +120,7 @@ class GPTAttention(Layer):
         qkv = qkv.reshape([B, S, self.n_heads, 3 * self.head_dim])
         qkv = mark_sharding(qkv, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
         q, k, v = qkv.split(3, axis=-1)                         # [B,S,H,D]
-        ctx = pallas.flash_attention(
+        ctx = _flash_attention(
             q, k, v, dropout_p=self.dropout_p, is_causal=True,
             training=self.training)
         ctx = mark_sharding(ctx, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))

@@ -22,8 +22,12 @@ from ...core import rng as rng_mod
 
 @functools.lru_cache(maxsize=1)
 def use_pallas() -> bool:
+    """True when the default backend is TPU hardware (incl. tunneled
+    platforms such as "axon" — see core.device._TPU_PLATFORMS)."""
+    from ...core.device import _TPU_PLATFORMS
+
     try:
-        return jax.default_backend() == "tpu"
+        return jax.default_backend() in _TPU_PLATFORMS
     except Exception:
         return False
 
@@ -55,7 +59,8 @@ def flash_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                     is_causal=False, training=True, name=None):
     """Flash attention over [B, S, H, D] tensors.
 
-    On TPU this dispatches to the Pallas kernel (flash_attention.py); on other
+    On TPU this dispatches to the Pallas kernel (flash_attention_kernel.py);
+    on other
     backends it runs the XLA oracle.  Autograd flows through jax.vjp either
     way (the Pallas path defines a custom_vjp with its own backward kernel).
     """
@@ -63,7 +68,7 @@ def flash_attention(query, key, value, attn_mask=None, dropout_p=0.0,
     key_arr = rng_mod.next_key() if p > 0.0 else None
 
     if use_pallas() and attn_mask is None and p == 0.0:
-        from .flash_attention import flash_attention_fused, supports
+        from .flash_attention_kernel import flash_attention_fused, supports
 
         if supports(tuple(query.shape), tuple(key.shape)):
             def _primal(q, k, v):

@@ -27,6 +27,14 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
+# MXU precision for the kernel's dot_generals.  bf16 operands are exact on
+# the MXU with f32 accumulation, and Mosaic rejects the fp32 ("highest")
+# contract precision for bf16 lhs ("Bad lhs type"), so pin DEFAULT there;
+# f32 operands defer to the global jax_default_matmul_precision (tests set
+# "highest" for the f32-shadow oracle comparisons).
+def _precision_for(dtype):
+    return (jax.lax.Precision.DEFAULT if dtype == jnp.bfloat16 else None)
+
 
 def _row_ids(iq, ik, block_q, block_k):
     shape = (block_q, block_k)
@@ -40,7 +48,8 @@ def _scores(q, k, iq, ik, *, scale, causal, block_q, block_k):
     both bwd kernels so the mask/scale math cannot diverge."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
+        preferred_element_type=jnp.float32,
+        precision=_precision_for(q.dtype)) * scale
     if causal:
         rows, cols = _row_ids(iq, ik, block_q, block_k)
         s = jnp.where(rows >= cols, s, NEG_INF)
@@ -55,7 +64,8 @@ def _p_ds(q, k, v, do, lse, delta, iq, ik, *, scale, causal, block_q, block_k):
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32,
+        precision=_precision_for(do.dtype))
     ds = p * (dp - delta) * scale
     return p, ds
 
@@ -92,7 +102,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_new = l_ref[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)    # [BQ, D]
+            preferred_element_type=jnp.float32,
+            precision=_precision_for(v.dtype))         # [BQ, D]
         acc_ref[:] = acc_ref[:] * corr + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -171,10 +182,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # dv += pᵀ @ dO ; dk += dsᵀ @ q
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_precision_for(do.dtype))
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_precision_for(q.dtype))
 
     @pl.when(iq == nq - 1)
     def _finalize():
@@ -206,7 +219,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       causal=causal, block_q=block_q, block_k=block_k)
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_precision_for(k.dtype))
 
     @pl.when(ik == nk - 1)
     def _finalize():

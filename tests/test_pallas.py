@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import pytest
 
 from paddle_tpu.ops.pallas import _sdpa_reference
-from paddle_tpu.ops.pallas.flash_attention import flash_attention_fused
+from paddle_tpu.ops.pallas.flash_attention_kernel import flash_attention_fused
 
 
 def _qkv(B=2, S=256, H=4, D=64, dtype=jnp.float32, seed=0):
@@ -47,7 +47,7 @@ class TestFlashAttention:
                                   interpret=True)
 
     def test_supports_guard(self):
-        from paddle_tpu.ops.pallas.flash_attention import supports
+        from paddle_tpu.ops.pallas.flash_attention_kernel import supports
         assert supports((2, 256, 4, 64), (2, 256, 4, 64))
         assert not supports((2, 300, 4, 64), (2, 300, 4, 64),
                             block_q=128, block_k=128)
@@ -72,3 +72,36 @@ class TestFlashAttention:
         assert o.dtype == jnp.bfloat16
         np.testing.assert_allclose(
             np.asarray(o, np.float32), np.asarray(ref, np.float32), atol=3e-2)
+
+
+class TestPackageWiring:
+    def test_flash_attention_callable_after_kernel_import(self):
+        """Regression: the kernel submodule used to shadow the package-level
+        flash_attention function (round-1 ship-breaker)."""
+        import importlib
+        import paddle_tpu.ops.pallas as pkg
+        import paddle_tpu.ops.pallas.flash_attention_kernel  # noqa: F401
+        importlib.reload(paddle_tpu.ops.pallas.flash_attention_kernel)
+        assert callable(pkg.flash_attention)
+        # the models bind the function directly too
+        from paddle_tpu.models.gpt import _flash_attention
+        assert callable(_flash_attention)
+
+    def test_pallas_kernel_in_hlo_on_tpu(self):
+        """On a real TPU backend the jitted attention must lower to the Pallas
+        custom-call (kernel-engagement proof demanded by round-1 verdict)."""
+        from paddle_tpu.ops.pallas import use_pallas
+        if not use_pallas():
+            pytest.skip("no TPU backend attached")
+        from paddle_tpu.ops.pallas import flash_attention
+        from paddle_tpu.core.tensor import Tensor
+        q, k, v = _qkv(B=1, S=256, H=4, D=64, dtype=jnp.bfloat16)
+
+        def fn(q, k, v):
+            return flash_attention(Tensor._wrap(q), Tensor._wrap(k),
+                                   Tensor._wrap(v), is_causal=True)._value()
+
+        hlo = jax.jit(fn).lower(q, k, v).compile().as_text()
+        assert "custom-call" in hlo and (
+            "tpu_custom_call" in hlo or "mosaic" in hlo.lower()), (
+            "Pallas flash-attention kernel not engaged in compiled HLO")
