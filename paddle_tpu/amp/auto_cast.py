@@ -32,15 +32,21 @@ WHITE_LIST: Set[str] = {
     "conv1d", "conv2d", "conv3d",
     "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
     "flash_attention",
+    # embedding output sets the residual stream's dtype: bf16 keeps the
+    # whole transformer block (LN included, see below) in bf16
+    "embedding",
 }
 
 # numerically sensitive ops: force f32 (reference: BLACK_LIST —
-# softmax/CE/norms/exp/log/pow...)
+# softmax/CE/norms/exp/log/pow...).  The norm family is NOT listed: our
+# layer_norm/rms_norm/batch_norm kernels are dtype-preserving with f32
+# internal statistics (TPU-native AMP), so f32 promotion would only
+# force a full-f32 residual stream and cast traffic around every matmul.
 BLACK_LIST: Set[str] = {
     "softmax", "log_softmax", "cross_entropy", "parallel_cross_entropy",
     "bce_with_logits", "binary_cross_entropy", "nll_loss", "kl_div",
-    "ctc_loss", "layer_norm", "batch_norm", "instance_norm", "group_norm",
-    "rms_norm", "norm", "normalize", "mean", "sum", "var", "std",
+    "ctc_loss",
+    "mean", "sum", "var", "std",
     "cumsum", "logcumsumexp", "prod", "square_error_cost",
 }
 

@@ -47,21 +47,23 @@ def init(role_maker=None, is_collective: bool = True,
     hc = strategy.hybrid_configs
     n_dev = len(jax.devices())
     rest = hc.pp_degree * hc.sharding_degree * hc.sep_degree * hc.mp_degree
-    if hc.dp_degree <= 0:  # -1 (default) → infer from the device count,
+    dp_degree = hc.dp_degree  # local: never mutate the caller's strategy,
+    # so re-running init with the same object on another device count works
+    if dp_degree <= 0:  # -1 (default) → infer from the device count,
         # like the reference's dp_degree=-1 convention
         if n_dev % rest != 0:
             raise ValueError(
                 f"pp×sharding×sep×mp={rest} does not divide {n_dev} devices")
-        hc.dp_degree = n_dev // rest
-    if hc.dp_degree * rest != n_dev:
+        dp_degree = n_dev // rest
+    if dp_degree * rest != n_dev:
         raise ValueError(
-            f"hybrid degrees dp={hc.dp_degree} pp={hc.pp_degree} "
+            f"hybrid degrees dp={dp_degree} pp={hc.pp_degree} "
             f"sharding={hc.sharding_degree} sep={hc.sep_degree} "
-            f"mp={hc.mp_degree} multiply to {hc.dp_degree * rest}, "
+            f"mp={hc.mp_degree} multiply to {dp_degree * rest}, "
             f"but there are {n_dev} devices")
     topo = CommunicateTopology(
         ["data", "pipe", "sharding", "sep", "model"],
-        [hc.dp_degree, hc.pp_degree, hc.sharding_degree, hc.sep_degree,
+        [dp_degree, hc.pp_degree, hc.sharding_degree, hc.sep_degree,
          hc.mp_degree])
     hcg = HybridCommunicateGroup(topo)
     set_hybrid_communicate_group(hcg)

@@ -62,16 +62,26 @@ class PipelineParallel(Layer):
         body = layers.run_function
         start, end = _uniform_run(body)
         run_len = end - start
-        self._use_schedule = (
-            self.num_stages > 1 and run_len >= self.num_stages
-            and run_len % self.num_stages == 0)
-        if self._use_schedule:
+        # NOTE: a lax.switch-based schedule for structurally non-uniform
+        # stages was built and abandoned: jax 0.9.0 silently computes wrong
+        # gradients for lax.switch under shard_map varying-manual-axes
+        # (forward exact, backward corrupt; select/dynamic-index is exact —
+        # pinned by tests/test_pipeline.py::TestJaxSwitchVmaAD).  Until
+        # that is fixed upstream, non-uniform stacks run sequentially.
+        self._schedule = "sequential"
+        if (self.num_stages > 1 and run_len >= self.num_stages
+                and run_len % self.num_stages == 0):
+            self._schedule = "uniform"
             self._prologue = body[:start]
             self._body = body[start:end]
             self._epilogue = body[end:]
             self._template = self._body[0]
             self._body_leaves = [layer_param_leaves(l) for l in self._body]
         place_parameters(layers, hcg.mesh if hcg else None)
+
+    @property
+    def _use_schedule(self):
+        return self._schedule != "sequential"
 
     # -- forward ------------------------------------------------------------
 

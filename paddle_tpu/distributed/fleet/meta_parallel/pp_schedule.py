@@ -1,5 +1,5 @@
-"""Compiled pipeline schedule: fill-drain microbatch pipeline as ONE XLA
-program over the "pipe" mesh axis.
+"""Compiled pipeline schedule: microbatch pipeline as ONE XLA program over
+the "pipe" mesh axis.
 
 Reference parity: PipelineParallel.forward_backward_pipeline
 (fleet/meta_parallel/pipeline_parallel.py:82) — startup/steady/cooldown
@@ -12,15 +12,31 @@ processes.  The decoder stack's per-layer parameters are stacked to
 M + P − 1 ticks, each tick applying the stage's layers and rotating
 activations with `lax.ppermute` (the ICI-native p2p replacing
 send_v2/recv_v2).  TP/DP/ZeRO axes stay *auto* — GSPMD partitions inside
-the pipeline body, so mp×pp×dp×sharding compose in one program.  The
-backward pipeline is jax.vjp of the scan: reverse ppermutes fall out of
-autodiff instead of a hand-written 1F1B cooldown, and remat bounds
-activation memory the way 1F1B's schedule does.
+the pipeline body, so mp×pp×dp×sharding compose in one program.
+
+Schedule semantics vs the reference's 1F1B (pipeline_parallel.py:82-147):
+the backward pipeline here is jax.vjp of the scan — a reverse scan whose
+ppermutes are the transposed forward rotation.  Its *bubble* fraction,
+(P−1)/(M+P−1), is identical to 1F1B's (1F1B does not reduce the bubble,
+only the in-flight activation count).  1F1B's *memory* bound (≤P live
+microbatches instead of all M) is matched differently: each tick's stage
+body is rematerialized (`jax.checkpoint`), so the only cross-tick state
+the backward needs is the per-tick stage INPUT (size ∝ microbatch), and
+total live activations stay ∝ total-batch — independent of M — rather
+than M × per-stage activations.  tests/test_pipeline.py asserts this with
+compiled memory statistics.
+
+Non-uniform stacks run sequentially: a lax.switch-based per-stage
+dispatch was prototyped and removed because jax 0.9.0 computes wrong
+gradients for lax.switch under shard_map varying-manual-axes (forward
+exact, backward corrupt; dynamic-index select is exact — pinned by
+tests/test_pipeline.py::TestJaxSwitchVmaAD).
 """
 from __future__ import annotations
 
 from typing import List, Sequence
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import shard_map
@@ -47,6 +63,14 @@ def structure_signature(layer: Layer):
               for name, t in sorted(layer.named_buffers()))
 
 
+def _pipe_varying(x):
+    """Mark an array pipe-varying for the shard_map carry (pvary is
+    deprecated in favor of pcast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, ("pipe",), to="varying")
+    return jax.lax.pvary(x, ("pipe",))
+
+
 def _template_apply(template: Layer, leaf_arrays, x_arr):
     """Run template.forward on raw arrays via payload swap (tape off: the
     pipeline primal is differentiated as one op)."""
@@ -63,9 +87,60 @@ def _template_apply(template: Layer, leaf_arrays, x_arr):
     return out._value() if isinstance(out, Tensor) else out
 
 
+def _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh, key_arr,
+                   extra_flat, extra_specs):
+    """Common scan-over-ticks pipeline driver.
+
+    stage_fn(stage, t, key_l, x_in, extras) -> y runs one stage's layers
+    for one tick; it is rematerialized so the backward holds only per-tick
+    stage inputs.  The last stage's drained outputs come back replicated
+    via a masked psum.  (A pipe-stacked P("pipe") output + static slice —
+    one broadcast-from-owner instead of an all-reduce — was tried and
+    reverted: GSPMD lowers the slice to an all-reduce whose reduction
+    computation is `copy`, and XLA CPU's bf16 AllReducePromotion pass
+    CHECK-crashes cloning it ("Invalid binary instruction opcode copy"),
+    killing every bf16 test on the virtual CPU mesh.)"""
+
+    def inner(key_l, xs_full, *extras):
+        stage = jax.lax.axis_index("pipe")
+        pad = jnp.zeros((n_stages - 1,) + xs_full.shape[1:], xs_full.dtype)
+        ticks = jnp.concatenate([xs_full, pad], axis=0)
+        state0 = jnp.zeros(xs_full.shape[1:], xs_full.dtype)
+        # the carry becomes pipe-varying after the first ppermute; its
+        # initial value must carry the same vma type for scan
+        state0 = _pipe_varying(state0)
+
+        # prevent_cse=False is the documented setting for remat inside
+        # scan: it lets XLA hoist/CSE loop-invariant slices (per-stage
+        # param gathers) instead of saving them per tick
+        body = jax.checkpoint(
+            lambda x_in, t: stage_fn(stage, t, key_l, x_in, extras),
+            prevent_cse=False)
+
+        def tick(carry, inp):
+            state, t = carry
+            x_in = jnp.where(stage == 0, inp, state)
+            y = body(x_in, t)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            shifted = jax.lax.ppermute(y, "pipe", perm)
+            # only the last stage's y is pipeline output
+            out_t = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
+            return (shifted, t + 1), out_t
+
+        (_, _), ys = jax.lax.scan(tick, (state0, jnp.int32(0)), ticks)
+        ys = ys[n_stages - 1:]                       # drop fill ticks
+        return jax.lax.psum(ys, "pipe")              # replicate output
+
+    in_specs = (P(), P()) + tuple(extra_specs)
+    inner_f = shard_map(
+        inner, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        axis_names={"pipe"})
+    return inner_f(key_arr, xs, *extra_flat)
+
+
 def pipeline_apply(template: Layer, per_layer_leaves: Sequence[Sequence[Tensor]],
                    x: Tensor, n_stages: int, n_micro: int, mesh) -> Tensor:
-    """Run the uniform layer stack over the pipe axis.
+    """Run a uniform layer stack over the pipe axis.
 
     per_layer_leaves: [n_layers][n_leaf] framework Tensors (the real
     Parameters — their .grad receives the pipeline's backward).
@@ -95,58 +170,26 @@ def pipeline_apply(template: Layer, per_layer_leaves: Sequence[Sequence[Tensor]]
             s = s.reshape((n_stages, k_per_stage) + s.shape[1:])
             stacked.append(s)
 
-        def inner(key_l, xs_full, *stacked_local):
-            stage = jax.lax.axis_index("pipe")
-            pad = jnp.zeros((n_stages - 1,) + xs_full.shape[1:],
-                            xs_full.dtype)
-            ticks = jnp.concatenate([xs_full, pad], axis=0)
-            state0 = jnp.zeros(xs_full.shape[1:], xs_full.dtype)
-            # the carry becomes pipe-varying after the first ppermute; its
-            # initial value must carry the same vma type for scan
-            state0 = jax.lax.pvary(state0, ("pipe",))
+        def stage_fn(stage, t, key_l, x_in, stacked_local):
+            y = x_in
+            saved_state = gen_state._data
+            try:
+                for k in range(k_per_stage):
+                    arrs = [lv[0, k] for lv in stacked_local]
+                    # per-(tick, local-layer) RNG stream for dropout
+                    kk = jax.random.fold_in(
+                        jax.random.wrap_key_data(key_l),
+                        t * n_layers + stage * k_per_stage + k)
+                    gen_state._data = jax.random.key_data(kk)
+                    y = _template_apply(template, arrs, y)
+            finally:
+                gen_state._data = saved_state
+            return y
 
-            def stage_fn(x_in, t):
-                y = x_in
-                saved_state = gen_state._data
-                try:
-                    for k in range(k_per_stage):
-                        arrs = [lv[0, k] for lv in stacked_local]
-                        # per-(tick, local-layer) RNG stream for dropout
-                        kk = jax.random.fold_in(
-                            jax.random.wrap_key_data(key_l),
-                            t * n_layers + stage * k_per_stage + k)
-                        gen_state._data = jax.random.key_data(kk)
-                        y = _template_apply(template, arrs, y)
-                finally:
-                    gen_state._data = saved_state
-                return y
-
-            # remat each stage body: the scan otherwise keeps every tick's
-            # intermediate activations live (1F1B's memory bound, the
-            # reference's recompute_interval in PP)
-            stage_fn_ck = jax.checkpoint(stage_fn)
-
-            def tick(carry, inp):
-                state, t = carry
-                x_in = jnp.where(stage == 0, inp, state)
-                y = stage_fn_ck(x_in, t)
-                perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-                shifted = jax.lax.ppermute(y, "pipe", perm)
-                # only the last stage's y is pipeline output
-                out_t = jnp.where(stage == n_stages - 1, y,
-                                  jnp.zeros_like(y))
-                return (shifted, t + 1), out_t
-
-            (_, _), ys = jax.lax.scan(tick, (state0, jnp.int32(0)), ticks)
-            ys = ys[n_stages - 1:]                       # drop fill ticks
-            return jax.lax.psum(ys, "pipe")              # replicate output
-
-        in_specs = (P(), P()) + tuple(P("pipe") for _ in range(n_leaf))
-        inner_f = shard_map(
-            inner, mesh=mesh, in_specs=in_specs, out_specs=P(),
-            axis_names={"pipe"})
-        ys = inner_f(key_arr, xs, *stacked)
+        extra_specs = tuple(P("pipe") for _ in range(n_leaf))
+        ys = _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh,
+                            key_arr, tuple(stacked), extra_specs)
         return ys.reshape((B,) + ys.shape[2:])
 
-    return apply_op("pipeline_1f1b", primal,
+    return apply_op("pipeline_scan_remat", primal,
                     [x, region_key] + flat_params)

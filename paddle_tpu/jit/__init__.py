@@ -43,11 +43,12 @@ class StaticFunction:
     (reference: dygraph_to_static/program_translator.py:239)."""
 
     def __init__(self, fn, input_spec=None, build_strategy=None,
-                 backend=None):
+                 backend=None, donate=True):
         self._fn = fn
         self._input_spec = input_spec
         self._programs: dict = {}
         self._enabled = True
+        self._donate = donate
         functools.update_wrapper(self, fn)
 
     @property
@@ -82,7 +83,8 @@ class StaticFunction:
                self._extra_key(args))
         prog = self._programs.get(key)
         if prog is None:
-            prog = CompiledProgram(self._fn, args_tree, kwargs_tree)
+            prog = CompiledProgram(self._fn, args_tree, kwargs_tree,
+                                   donate=self._donate)
             prog.build(leaves)
             self._programs[key] = prog
         return prog(leaves)
@@ -103,7 +105,8 @@ class StaticFunction:
                self._extra_key(args))
         prog = self._programs.get(key)
         if prog is None:
-            prog = CompiledProgram(self._fn, args_tree, kwargs_tree)
+            prog = CompiledProgram(self._fn, args_tree, kwargs_tree,
+                                   donate=self._donate)
             prog.build(leaves)
             self._programs[key] = prog
         return prog
@@ -114,19 +117,26 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
+              backend=None, donate=True, **kwargs):
     """Decorator: compile a dygraph function to one XLA program
-    (reference: @paddle.jit.to_static, fluid/dygraph/jit.py:163)."""
+    (reference: @paddle.jit.to_static, fluid/dygraph/jit.py:163).
+
+    donate=False disables buffer donation of rewritten state (params,
+    optimizer moments): use it when eager code holds aliases of state
+    arrays across compiled calls (e.g. an eager GradScaler.step snapshot
+    around a compiled optimizer step) — donation would invalidate them.
+    Costs a second in-flight copy of every donated buffer."""
 
     def _decorate(fn):
         from ..nn.layer_base import Layer
 
         if isinstance(fn, Layer):
             layer = fn
-            static_fwd = StaticFunction(layer.forward, input_spec)
+            static_fwd = StaticFunction(layer.forward, input_spec,
+                                        donate=donate)
             layer.forward = static_fwd
             return layer
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, donate=donate)
 
     if function is not None:
         return _decorate(function)

@@ -10,3 +10,8 @@ from .gpt import (
     GPTConfig, GPTModel, GPTForCausalLM, GPTForCausalLMPipe,
     GPTPretrainingCriterion, GPT_CONFIGS, gpt_tiny, gpt2_345m, gpt3_13b,
 )
+from .llama import (
+    LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaForCausalLMPipe,
+    LlamaPretrainingCriterion, LLAMA_CONFIGS, llama_tiny, llama2_7b,
+    llama2_13b, llama2_70b,
+)

@@ -123,10 +123,14 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k)
+    # NOTE on the lse layout: the kernel-facing buffer is [BH, S, 1] (the
+    # only legal minor-dim block shape here), which HBM-pads 128x under
+    # T(8,128).  The caller immediately slices it to a compact [BH, S]
+    # residual so the padded form is transient, not saved (it was 127MB of
+    # pure padding per layer at S=1024, BH=256 — the round-2 OOM culprit).
     out_shape = [
         jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),  # row-stat layout:
-        # trailing singleton keeps blocks at (BQ, 1), legal TPU tiling
+        jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
     ]
     o, lse = pl.pallas_call(
         kernel,
@@ -150,7 +154,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return o, lse[:, :, 0]
 
 
 # -- backward --------------------------------------------------------------
@@ -231,6 +235,7 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
     q, k, v, o, lse = res
     do = g
     BH, S, D = q.shape
+    lse = lse[:, :, None]        # compact residual -> kernel-facing [BH,S,1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)                     # [BH, S, 1]
 

@@ -1,0 +1,68 @@
+"""Step-timing / throughput benchmark helper.
+
+Reference parity: ``python/paddle/profiler/timer.py`` (the ``benchmark()``
+API that hapi's fit loop uses for ips reporting).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class _Benchmark:
+    """Collects per-step wall times + sample counts; reports ips/latency.
+
+    ``begin()`` / ``step(num_samples)`` / ``end()`` mirror the reference's
+    hooks called from training loops (hapi.model.fit)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._t_last: Optional[float] = None
+        self._times: list = []
+        self._samples: list = []
+        self.events: int = 0
+
+    def begin(self) -> None:
+        self._t_last = time.perf_counter()
+
+    def step(self, num_samples: Optional[int] = None) -> None:
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._times.append(now - self._t_last)
+            self._samples.append(num_samples or 0)
+        self._t_last = now
+        self.events += 1
+
+    def end(self) -> None:
+        self._t_last = None
+
+    # -- reports -------------------------------------------------------
+    def step_info(self, unit: str = "samples") -> str:
+        if not self._times:
+            return ""
+        # drop the first (compile) step from steady-state stats when there
+        # are enough samples to afford it
+        ts = self._times[1:] if len(self._times) > 2 else self._times
+        ss = self._samples[1:] if len(self._times) > 2 else self._samples
+        avg = sum(ts) / len(ts)
+        msg = f"avg_step: {avg * 1e3:.2f} ms"
+        if any(ss):
+            ips = sum(ss) / sum(ts)
+            msg += f", ips: {ips:.2f} {unit}/s"
+        return msg
+
+    @property
+    def avg_step_seconds(self) -> float:
+        ts = self._times[1:] if len(self._times) > 2 else self._times
+        return sum(ts) / len(ts) if ts else 0.0
+
+
+_bench = _Benchmark()
+
+
+def benchmark() -> _Benchmark:
+    """Global benchmark singleton (reference: paddle.profiler.utils uses a
+    module-level timer the fit loop talks to)."""
+    return _bench

@@ -219,3 +219,28 @@ class TestJitSaveLoad:
         want = net(x).numpy()
         got = loaded(x).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestDonateOptOut:
+    def test_donate_false_preserves_aliases(self):
+        """to_static(donate=False): an eager alias of a parameter captured
+        before the compiled state-mutating step stays valid (with
+        donation, the buffer would be invalidated)."""
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+
+        @paddle.jit.to_static(donate=False)
+        def step(x):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = t(np.ones((2, 4)))
+        alias = lin.weight._value()  # eager alias of the raw buffer
+        step(x)
+        step(x)
+        # donation would have deleted this buffer; donate=False keeps it
+        np.asarray(alias)

@@ -1,0 +1,107 @@
+"""Profiler subsystem tests (SURVEY §5.1; reference profiler.py:271 state
+machine + profiler_statistic.py tables)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import ProfilerState, SortedKeys, make_scheduler
+from paddle_tpu.profiler.statistic import StatisticData
+
+
+class TestScheduler:
+    def test_cycle(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=0)
+        states = [sched(i) for i in range(8)]
+        assert states == [
+            ProfilerState.CLOSED, ProfilerState.READY,
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+        ] * 2
+
+    def test_skip_first_and_repeat(self):
+        sched = make_scheduler(closed=0, ready=0, record=1, repeat=2,
+                               skip_first=2)
+        states = [sched(i) for i in range(6)]
+        assert states == [
+            ProfilerState.CLOSED, ProfilerState.CLOSED,
+            ProfilerState.RECORD_AND_RETURN, ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED, ProfilerState.CLOSED,
+        ]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            make_scheduler(closed=0, ready=0, record=0)
+
+
+class TestStatistic:
+    def _trace(self):
+        return {"traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "python host"}},
+            {"ph": "M", "pid": 2, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "step", "ts": 0,
+             "dur": 100},
+            {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.1", "ts": 10,
+             "dur": 40},
+            {"ph": "X", "pid": 2, "tid": 2, "name": "fusion.1", "ts": 30,
+             "dur": 40},  # overlaps → busy union = [10, 70]
+        ]}
+
+    def test_aggregation_and_busy_union(self):
+        data = StatisticData.from_chrome_trace(self._trace())
+        assert data.host["step"].call == 1
+        assert data.device["fusion.1"].call == 2
+        assert data.device["fusion.1"].total_us == 80
+        assert data.device_busy_us == 60  # merged overlap, not 80
+        assert data.wall_us == 100
+
+    def test_format_tables(self):
+        data = StatisticData.from_chrome_trace(self._trace())
+        out = data.format_tables(sorted_by=SortedKeys.DeviceTotal)
+        assert "fusion.1" in out and "device busy" in out
+
+
+class TestProfilerE2E:
+    def test_capture_and_summary(self, tmp_path):
+        d = str(tmp_path)
+        p = profiler.Profiler(
+            scheduler=make_scheduler(closed=1, ready=1, record=2, repeat=1),
+            on_trace_ready=profiler.export_chrome_tracing(d), log_dir=d)
+        f = jax.jit(lambda x: x @ x)
+        x = jnp.ones((128, 128))
+        f(x)
+        p.start()
+        for _ in range(5):
+            with profiler.RecordEvent("train_step"):
+                f(x).block_until_ready()
+            p.step(num_samples=128)
+        p.stop()
+        assert p.chrome_trace_path and os.path.exists(p.chrome_trace_path)
+        data = p.statistic_data()
+        assert data is not None
+        # the RecordEvent span shows up; only the 2 RECORD steps captured
+        assert any("train_step" in k for k in data.host)
+        assert data.host[[k for k in data.host if "train_step" in k][0]].call == 2
+        out = p.summary(row_limit=5)
+        assert "avg step" in out
+
+    def test_timer_only(self):
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        for _ in range(3):
+            p.step(num_samples=4)
+        p.stop()
+        assert p.chrome_trace_path is None
+
+    def test_benchmark_timer(self):
+        b = profiler.benchmark()
+        b.reset()
+        b.begin()
+        for _ in range(3):
+            b.step(num_samples=8)
+        b.end()
+        assert b.avg_step_seconds >= 0
+        assert "avg_step" in b.step_info()

@@ -1,0 +1,70 @@
+"""On-chip sweep: isolate the contribution of dropout path / recompute /
+batch size to the 345M step time.  Prints one JSON line per config."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def run(batch, seq, dropout, recomp):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt2_345m, GPTForCausalLM, GPTPretrainingCriterion
+    from paddle_tpu.distributed import fleet
+    import jax
+
+    paddle.seed(0)
+    cfg = gpt2_345m(recompute=recomp, hidden_dropout_prob=dropout,
+                    attention_probs_dropout_prob=dropout)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    crit = GPTPretrainingCriterion()
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=1e-4,
+                               parameters=model.parameters()))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
+    y = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
+    for _ in range(3):
+        loss = train_step(x, y)
+    float(loss)
+    n = 8
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = train_step(x, y)
+    float(loss)
+    dt = (time.perf_counter() - t0) / n
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    toks = batch * seq / dt
+    mfu = toks * 6.0 * n_params / 197e12
+    print(json.dumps({"batch": batch, "seq": seq, "dropout": dropout,
+                      "recompute": recomp, "step_ms": round(dt * 1e3, 1),
+                      "tok_s": round(toks, 0), "mfu": round(mfu, 4)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    import sys
+    cfgs = [
+        (4, 1024, 0.1, True),    # round-1 bench config
+        (4, 1024, 0.0, True),    # kernel engaged
+        (4, 1024, 0.0, False),   # no recompute
+        (8, 1024, 0.0, False),
+        (16, 1024, 0.0, False),
+        (8, 1024, 0.1, False),   # dropout cost w/o recompute
+    ]
+    if len(sys.argv) > 1:
+        idx = [int(i) for i in sys.argv[1].split(",")]
+        cfgs = [cfgs[i] for i in idx]
+    for c in cfgs:
+        run(*c)
