@@ -71,6 +71,47 @@ def _pipe_varying(x):
     return jax.lax.pvary(x, ("pipe",))
 
 
+def _psum_pipe_f32(x):
+    """psum over "pipe" with the reduction carried out in f32.
+
+    Sub-f32 all-reduces over pipe are forbidden: XLA CPU's bf16
+    AllReducePromotion pass CHECK-crashes ("Invalid binary instruction
+    opcode copy") when layout assignment has inserted a root copy into the
+    psum's reduction computation — which it does for the shard_map
+    `psum_invariant` regions this schedule generates.  An f32 all-reduce is
+    never touched by that pass (and is also the numerically safer
+    accumulation); the cast pair is fused away by XLA on TPU.
+    """
+    dt = x.dtype
+    if dt in (jnp.float32, jnp.float64):
+        return jax.lax.psum(x, "pipe")
+    return jax.lax.psum(x.astype(jnp.float32), "pipe").astype(dt)
+
+
+@jax.custom_vjp
+def _enter_pipe(x):
+    """Invariant→pipe-varying cast whose backward reduces in f32.
+
+    The default transpose of reading a pipe-invariant array inside the
+    pipeline body is a bf16 `psum_invariant` over "pipe" — the exact
+    all-reduce shape that CHECK-crashes XLA CPU (see _psum_pipe_f32).
+    Routing the input through this custom_vjp keeps the forward free
+    (a vma cast, no collective) and makes the cotangent reduction f32.
+    """
+    return _pipe_varying(x)
+
+
+def _enter_pipe_fwd(x):
+    return _pipe_varying(x), None
+
+
+def _enter_pipe_bwd(_, g):
+    return (_psum_pipe_f32(g),)
+
+
+_enter_pipe.defvjp(_enter_pipe_fwd, _enter_pipe_bwd)
+
+
 def _template_apply(template: Layer, leaf_arrays, x_arr):
     """Run template.forward on raw arrays via payload swap (tape off: the
     pipeline primal is differentiated as one op)."""
@@ -103,7 +144,11 @@ def _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh, key_arr,
 
     def inner(key_l, xs_full, *extras):
         stage = jax.lax.axis_index("pipe")
+        # enter the manual pipe region through the f32-backward cast so no
+        # bf16 psum_invariant is ever emitted over "pipe"
+        xs_full = _enter_pipe(xs_full)
         pad = jnp.zeros((n_stages - 1,) + xs_full.shape[1:], xs_full.dtype)
+        pad = _pipe_varying(pad)
         ticks = jnp.concatenate([xs_full, pad], axis=0)
         state0 = jnp.zeros(xs_full.shape[1:], xs_full.dtype)
         # the carry becomes pipe-varying after the first ppermute; its
@@ -129,7 +174,7 @@ def _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh, key_arr,
 
         (_, _), ys = jax.lax.scan(tick, (state0, jnp.int32(0)), ticks)
         ys = ys[n_stages - 1:]                       # drop fill ticks
-        return jax.lax.psum(ys, "pipe")              # replicate output
+        return _psum_pipe_f32(ys)                    # replicate output
 
     in_specs = (P(), P()) + tuple(extra_specs)
     inner_f = shard_map(

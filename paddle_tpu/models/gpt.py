@@ -232,6 +232,34 @@ class GPTForCausalLM(Layer):
             logits = h.matmul(w.t())
         return mark_sharding(logits, _act_spec(last=MODEL_AXIS))
 
+    def compute_loss(self, input_ids, labels, loss_mask=None,
+                     position_ids=None, ignore_index: int = -100):
+        """Forward + causal-LM loss without materializing [B,S,V] logits.
+
+        Uses ops.fused.fused_linear_cross_entropy (vocab-blockwise streamed
+        CE — the memory fusion behind the reference's
+        c_softmax_with_cross_entropy path) whenever the head weight is not
+        vocab-sharded; under tensor parallelism it falls back to the
+        vocab-parallel logits + ParallelCrossEntropy path.
+        """
+        from ..distributed import mesh as _mesh_mod
+        from ..ops.fused import fused_linear_cross_entropy
+
+        m = _mesh_mod.get_global_mesh()
+        mp = m.shape.get(MODEL_AXIS, 1) if m is not None else 1
+        if mp > 1:
+            crit = GPTPretrainingCriterion(ignore_index=ignore_index)
+            return crit(self.forward(input_ids, position_ids), labels,
+                        loss_mask)
+        h = self.gpt(input_ids, position_ids)
+        if self.lm_head is not None:
+            return fused_linear_cross_entropy(
+                h, self.lm_head.weight, labels, loss_mask=loss_mask,
+                ignore_index=ignore_index, transpose_weight=True)
+        w = self.gpt.embeddings.word_embeddings.weight
+        return fused_linear_cross_entropy(
+            h, w, labels, loss_mask=loss_mask, ignore_index=ignore_index)
+
 
 class _GPTHeadPipe(Layer):
     """Final LN + LM head for the pipelined model.  The tied embedding

@@ -1,0 +1,112 @@
+"""fused_linear_cross_entropy: value + grad equivalence with the unfused
+logits path (reference contract: c_softmax_with_cross_entropy ≡ matmul +
+softmax_with_cross_entropy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.fused import fused_linear_cross_entropy
+
+
+def _naive_loss(h, w, labels, ignore_index=-100, loss_mask=None):
+    logits = h.matmul(w.t())
+    loss = F.cross_entropy(
+        logits.reshape([-1, logits.shape[-1]]).astype("float32"),
+        labels.reshape([-1]), ignore_index=ignore_index, reduction="none")
+    if loss_mask is not None:
+        m = loss_mask.reshape([-1]).astype("float32")
+        return (loss * m).sum() / m.sum().clip(min=1.0)
+    valid = (labels.reshape([-1]) != ignore_index).astype("float32")
+    return (loss * valid).sum() / valid.sum().clip(min=1.0)
+
+
+class TestFusedLinearCrossEntropy:
+    def _setup(self, N=6, S=7, H=16, V=37, seed=0):
+        rs = np.random.RandomState(seed)
+        h = paddle.to_tensor(rs.randn(N, S, H).astype(np.float32))
+        w = paddle.to_tensor(0.1 * rs.randn(V, H).astype(np.float32))
+        y = paddle.to_tensor(rs.randint(0, V, (N, S)).astype(np.int64))
+        h.stop_gradient = False
+        w.stop_gradient = False
+        return h, w, y
+
+    def test_matches_naive_value_and_grads(self):
+        h, w, y = self._setup()
+        loss = fused_linear_cross_entropy(h, w, y, block_size=16)
+        loss.backward()
+        gh, gw = np.asarray(h.grad), np.asarray(w.grad)
+
+        h2, w2, _ = self._setup()
+        ref = _naive_loss(h2, w2, y)
+        ref.backward()
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+        np.testing.assert_allclose(gh, np.asarray(h2.grad), atol=1e-5)
+        np.testing.assert_allclose(gw, np.asarray(w2.grad), atol=1e-5)
+
+    def test_ignore_index(self):
+        h, w, y = self._setup()
+        yn = np.array(y.numpy())
+        yn[0, :4] = -100
+        y = paddle.to_tensor(yn)
+        loss = fused_linear_cross_entropy(h, w, y, block_size=8)
+        loss.backward()
+        h2, w2, _ = self._setup()
+        ref = _naive_loss(h2, w2, y)
+        ref.backward()
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(h.grad), np.asarray(h2.grad),
+                                   atol=1e-5)
+        # ignored rows get exactly zero hidden-grad
+        np.testing.assert_array_equal(np.asarray(h.grad)[0, :4], 0.0)
+
+    def test_loss_mask(self):
+        h, w, y = self._setup()
+        m = paddle.to_tensor(
+            (np.arange(6 * 7).reshape(6, 7) % 3 != 0).astype(np.float32))
+        loss = fused_linear_cross_entropy(h, w, y, loss_mask=m, block_size=64)
+        h2, w2, _ = self._setup()
+        ref = _naive_loss(h2, w2, y, loss_mask=m)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_transpose_weight_layout(self):
+        h, w, y = self._setup()
+        wt = paddle.to_tensor(np.asarray(w.numpy()).T.copy())
+        wt.stop_gradient = False
+        loss = fused_linear_cross_entropy(h, wt, y, transpose_weight=True,
+                                          block_size=16)
+        ref = _naive_loss(*self._setup()[:2], y)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_bf16_close_to_f32(self):
+        h, w, y = self._setup(H=32, V=64)
+        hb = h.astype("bfloat16")
+        hb.stop_gradient = False
+        loss = fused_linear_cross_entropy(hb, w, y, block_size=32)
+        ref = _naive_loss(*self._setup(H=32, V=64)[:2], y)
+        assert abs(float(loss) - float(ref)) / float(ref) < 0.02
+
+    def test_under_jit(self):
+        h, w, y = self._setup()
+
+        @paddle.jit.to_static
+        def f(h, w, y):
+            return fused_linear_cross_entropy(h, w, y, block_size=16)
+
+        ref = _naive_loss(*self._setup()[:2], y)
+        np.testing.assert_allclose(float(f(h, w, y)), float(ref), rtol=1e-5)
+
+    def test_model_compute_loss_matches_criterion(self):
+        from paddle_tpu.models import (
+            gpt_tiny, GPTForCausalLM, GPTPretrainingCriterion)
+
+        paddle.seed(0)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (2, 8)))
+        y = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (2, 8)))
+        ref = crit(model(x), y)
+        fused = model.compute_loss(x, y)
+        np.testing.assert_allclose(float(fused), float(ref), rtol=2e-4)
