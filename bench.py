@@ -32,24 +32,28 @@ def peak_flops_per_chip() -> float:
 
 def main():
     import paddle_tpu as paddle
-    from paddle_tpu.models import gpt2_345m, GPTForCausalLM, GPTPretrainingCriterion
+    from paddle_tpu.models import gpt2_345m, GPTForCausalLM
     from paddle_tpu.distributed import fleet
 
     strategy = paddle.distributed.DistributedStrategy()
     fleet.init(is_collective=True, strategy=strategy)
 
+    import os
     import jax
     paddle.seed(0)
-    # Tuned on v5e (tools/bench_sweep.py, round 2): dropout 0 (standard
-    # MFU-bench practice; also engages the Pallas flash kernel, whose
-    # dispatch guard requires p==0), recompute off (345M + AdamW f32 state
-    # + flash-attn activations fit 16G HBM), batch 4/chip x 1024 (batch 8
-    # measured slower per token; 16 OOMs on the f32 logits temp)
+    # Tuned on v5e: dropout 0 (standard MFU-bench practice; also engages
+    # the Pallas flash kernel, whose dispatch guard requires p==0),
+    # recompute off (345M + AdamW f32 state + flash-attn activations fit
+    # 16G HBM).  The LM loss goes through model.compute_loss →
+    # fused_linear_cross_entropy (vocab-blockwise streamed CE): no [B,S,V]
+    # logits tensor is ever materialized, which un-caps the batch that
+    # previously OOMed at 16 on the f32 logits temp.
     cfg = gpt2_345m(recompute=False, hidden_dropout_prob=0.0,
                     attention_probs_dropout_prob=0.0)
-    seq, batch = 1024, 4 * len(jax.devices())
+    seq = 1024
+    batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "16")) \
+        * len(jax.devices())
     model = fleet.distributed_model(GPTForCausalLM(cfg))
-    crit = GPTPretrainingCriterion()
     opt = fleet.distributed_optimizer(
         paddle.optimizer.AdamW(learning_rate=1e-4,
                                parameters=model.parameters()))
@@ -57,7 +61,7 @@ def main():
     @paddle.jit.to_static
     def train_step(x, y):
         with paddle.amp.auto_cast(dtype="bfloat16"):
-            loss = crit(model(x), y)
+            loss = model.compute_loss(x, y)
         loss.backward()
         opt.step()
         opt.clear_grad()

@@ -32,30 +32,17 @@ from .core.device import (
 from .ops import *  # noqa: F401,F403 — the paddle.* op surface
 from .ops.logic import is_tensor
 
-# Subsystem imports (grown as modules land; see _OPTIONAL below).
-import importlib as _importlib
+# Subsystem imports.  Every listed module must exist — a broken subpackage
+# should fail the import loudly, not silently drop off the namespace
+# (round-2 review: the try/except-ImportError pattern hid breakage).
+from . import (  # noqa: F401
+    nn, optimizer, amp, io, jit, vision, metric, distributed, autograd,
+    framework, profiler, incubate, hapi,
+)
 
-_OPTIONAL = [
-    "nn", "optimizer", "amp", "io", "jit", "static", "vision", "metric",
-    "distributed", "autograd", "framework", "profiler", "incubate", "utils",
-    "hapi", "text", "sparse", "linalg_api",
-]
-for _m in _OPTIONAL:
-    try:
-        globals()[_m] = _importlib.import_module(f".{_m}", __name__)
-    except ImportError:
-        pass
-del _importlib, _m
-
-try:
-    from .framework.io import save, load  # noqa: F401
-except ImportError:
-    pass
-try:
-    from .hapi.model import Model  # noqa: F401
-    from .hapi import callbacks  # noqa: F401
-except ImportError:
-    pass
+from .framework.io import save, load  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 
 # paddle.disable_static/enable_static parity: this framework is always
 # "dygraph" at the API level; to_static compiles whole programs via XLA.

@@ -1,0 +1,8 @@
+"""paddle.autograd parity namespace (reference: python/paddle/autograd) —
+re-exports the eager tape engine from core.autograd."""
+from ..core.autograd import (  # noqa: F401
+    backward, grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+)
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled"]

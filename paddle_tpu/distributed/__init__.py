@@ -19,6 +19,8 @@ from .strategy import DistributedStrategy
 
 from . import fleet  # noqa: E402
 from . import sharding  # noqa: E402
+from . import auto_parallel  # noqa: E402
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op, Engine
 from .sharding_spec import (
     mark_sharding, shard_parameter, set_param_spec, get_param_spec, batch_spec,
 )

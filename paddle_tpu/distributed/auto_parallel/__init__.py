@@ -1,0 +1,340 @@
+"""Semi-automatic parallelism (reference: python/paddle/distributed/
+auto_parallel — interface.py:34 shard_tensor, :73 shard_op, engine.py:51
+Engine, process_mesh.py ProcessMesh).
+
+TPU-native design (SURVEY.md §7 step 7): the reference needs 21k LoC of
+completion/partitioner/reshard because it must PROPAGATE user annotations
+through a serial program, SPLIT it per rank, and INSERT communication.  On
+TPU all three are XLA-GSPMD's job: user annotations become
+`NamedSharding`/`with_sharding_constraint` on a global-view program, the
+partitioner propagates them through every op, and collectives are emitted
+where dataflow demands.  What remains — and what this module provides — is
+the reference's USER surface: ProcessMesh topology, dims_mapping-style
+annotation of tensors/ops, and an Engine that takes (model, loss, optimizer)
+and runs compiled distributed train/eval/predict steps.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .. import mesh as mesh_mod
+from ..sharding_spec import mark_sharding, set_param_spec, shard_parameter
+
+__all__ = ["ProcessMesh", "get_default_process_mesh", "shard_tensor",
+           "shard_op", "Engine"]
+
+_default_process_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """Cartesian topology of processes/devices (reference:
+    auto_parallel/process_mesh.py).
+
+    `mesh` is a (nested) list of logical process ids — its shape is the
+    topology; `dim_names` names the dimensions (defaults d0, d1, …).  On TPU
+    the logical ids index into `jax.devices()` and the ProcessMesh lowers to
+    a `jax.sharding.Mesh` with the same names.
+    """
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[Sequence[str]] = None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if arr.ndim == 0:
+            raise ValueError("process mesh must have at least one dimension")
+        self._ids = arr
+        self._dim_names = (list(dim_names) if dim_names is not None
+                           else [f"d{i}" for i in range(arr.ndim)])
+        if len(self._dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(self._dim_names)} dim_names for a {arr.ndim}-D mesh")
+        self._jax_mesh: Optional[Mesh] = None
+        global _default_process_mesh
+        if _default_process_mesh is None:
+            _default_process_mesh = self
+
+    @property
+    def mesh(self):
+        return self._ids.tolist()
+
+    @property
+    def topology(self) -> List[int]:
+        return list(self._ids.shape)
+
+    shape = topology
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def processes(self) -> List[int]:
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            if self._ids.size > len(devs):
+                raise ValueError(
+                    f"process mesh names {self._ids.size} processes, "
+                    f"{len(devs)} devices available")
+            dev_arr = np.empty(self._ids.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._ids):
+                dev_arr[idx] = devs[int(pid)]
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.topology}, "
+                f"dim_names={self._dim_names})")
+
+
+def get_default_process_mesh() -> Optional[ProcessMesh]:
+    return _default_process_mesh
+
+
+def _spec_from_attr(ndim: int, pm: ProcessMesh, dims_mapping=None,
+                    shard_spec=None) -> P:
+    """dims_mapping [i]=k maps tensor dim i onto mesh dim k (-1 replicated);
+    shard_spec is the name-based variant [dim_name | None, ...]."""
+    if shard_spec is not None:
+        entries = list(shard_spec) + [None] * (ndim - len(shard_spec))
+        for e in entries:
+            if e is not None and e not in pm.dim_names:
+                raise ValueError(f"unknown mesh dim {e!r}; has {pm.dim_names}")
+        return P(*entries)
+    if dims_mapping is None:
+        return P(*([None] * ndim))
+    entries = []
+    for m in list(dims_mapping)[:ndim]:
+        entries.append(None if m == -1 else pm.dim_names[m])
+    entries += [None] * (ndim - len(entries))
+    return P(*entries)
+
+
+def _resolve(dist_attr, process_mesh, shard_spec, ndim):
+    dist_attr = dist_attr or {}
+    pm = (process_mesh or dist_attr.get("process_mesh")
+          or _default_process_mesh)
+    if pm is None:
+        raise ValueError("no ProcessMesh: pass process_mesh= or create one")
+    if not isinstance(pm, ProcessMesh):
+        pm = ProcessMesh(pm)
+    spec = _spec_from_attr(ndim, pm, dist_attr.get("dims_mapping"),
+                           shard_spec)
+    return pm, spec
+
+
+def shard_tensor(x, dist_attr: Optional[dict] = None, *,
+                 process_mesh=None, shard_spec=None):
+    """Annotate a tensor with a distributed placement (reference:
+    interface.py:34).  Accepts the reference dict form
+    ``{"process_mesh": pm, "dims_mapping": [0, -1]}`` or the name-based
+    ``shard_spec=["x", None]``.  Parameters are annotated AND immediately
+    placed; activations get a differentiable sharding constraint."""
+    pm, spec = _resolve(dist_attr, process_mesh, shard_spec, x.ndim)
+    m = pm.jax_mesh()
+    if mesh_mod.get_global_mesh() is None:
+        mesh_mod.set_global_mesh(m)
+    if getattr(x, "is_leaf", False) and not x.stop_gradient:
+        return shard_parameter(x, spec, m)
+    return mark_sharding(x, spec, m)
+
+
+def shard_op(op_fn: Callable, dist_attr: Optional[dict] = None, *,
+             process_mesh=None, out_shard_specs=None):
+    """Wrap a callable so its outputs carry sharding annotations
+    (reference: interface.py:73 DistributedModule)."""
+
+    def _wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        res = []
+        for i, o in enumerate(outs):
+            if not isinstance(o, Tensor):
+                res.append(o)
+                continue
+            sspec = None
+            if out_shard_specs is not None and i < len(out_shard_specs):
+                sspec = out_shard_specs[i]
+            da = None
+            if dist_attr and "dims_mapping" in dist_attr:
+                da = dist_attr
+            if sspec is None and da is None:
+                res.append(o)
+                continue
+            pm, spec = _resolve(da, process_mesh
+                                or (dist_attr or {}).get("process_mesh"),
+                                sspec, o.ndim)
+            res.append(mark_sharding(o, spec, pm.jax_mesh()))
+        if isinstance(out, tuple):
+            return tuple(res)
+        if isinstance(out, list):
+            return res
+        return res[0]
+
+    return _wrapped
+
+
+class Engine:
+    """Train/eval/predict driver for annotated models (reference:
+    engine.py:51 __init__, :87 prepare, :259 fit, :298 evaluate, :340
+    predict).  The reference's _plan/_parallel passes (planner_v2,
+    parallelizer_v2) have no analog here: `prepare` jit-compiles a global
+    train step and GSPMD plans + partitions it."""
+
+    def __init__(self, model=None, inputs_spec=None, labels_spec=None,
+                 cluster=None, strategy=None):
+        self.model = model
+        self.inputs_spec = inputs_spec
+        self.labels_spec = labels_spec
+        self.strategy = strategy
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_step = None
+        self._pred_step = None
+
+    # -- setup ---------------------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None, gradient_scale=True,
+                metrics=None, all_ranks=False):
+        from ... import optimizer as opt_mod
+
+        if optimizer is not None and not isinstance(
+                optimizer, opt_mod.Optimizer):
+            raise TypeError("'optimizer' must be a paddle Optimizer")
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("'loss' must be callable")
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        if mesh_mod.get_global_mesh() is None and _default_process_mesh:
+            mesh_mod.set_global_mesh(_default_process_mesh.jax_mesh())
+        self._build_steps()
+        return self
+
+    def _constrain_inputs(self, x, spec_like):
+        if spec_like is None or not isinstance(x, Tensor):
+            return x
+        pm, spec = _resolve(
+            spec_like if isinstance(spec_like, dict) else None, None,
+            spec_like if not isinstance(spec_like, dict) else None, x.ndim)
+        return mark_sharding(x, spec, pm.jax_mesh())
+
+    def _build_steps(self):
+        from ... import jit as jit_mod
+
+        model, loss_fn, opt = self.model, self._loss, self._optimizer
+
+        def _train(x, y):
+            x = self._constrain_inputs(x, self.inputs_spec)
+            y = self._constrain_inputs(y, self.labels_spec)
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        def _eval(x, y):
+            x = self._constrain_inputs(x, self.inputs_spec)
+            loss = loss_fn(model(x), y)
+            return loss
+
+        def _pred(x):
+            x = self._constrain_inputs(x, self.inputs_spec)
+            return model(x)
+
+        if opt is not None and loss_fn is not None:
+            self._train_step = jit_mod.to_static(_train)
+        if loss_fn is not None:
+            self._eval_step = jit_mod.to_static(_eval)
+        self._pred_step = jit_mod.to_static(_pred)
+
+    # -- iteration -----------------------------------------------------------
+
+    def _batches(self, data, batch_size, shuffle=False):
+        from ...io import DataLoader, Dataset
+
+        if isinstance(data, DataLoader):
+            yield from data
+            return
+        if isinstance(data, (tuple, list)) and len(data) == 2 and not \
+                isinstance(data[0], (int, float)):
+            xs, ys = data
+            n = len(xs)
+            for i in range(0, n - n % batch_size or n, batch_size):
+                yield (Tensor._wrap(np.asarray(xs[i:i + batch_size])),
+                       Tensor._wrap(np.asarray(ys[i:i + batch_size])))
+            return
+        if isinstance(data, Dataset):
+            loader = DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+            yield from loader
+            return
+        raise TypeError(f"unsupported data {type(data)}")
+
+    def fit(self, train_data, batch_size: int = 1, epochs: int = 1,
+            steps_per_epoch: Optional[int] = None, verbose: int = 0,
+            collate_fn=None):
+        if self._train_step is None:
+            raise RuntimeError("call prepare(optimizer=..., loss=...) first")
+        history = []
+        for ep in range(epochs):
+            for step, batch in enumerate(self._batches(
+                    train_data, batch_size, shuffle=False)):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                x, y = batch if isinstance(batch, (tuple, list)) else (batch,
+                                                                       None)
+                loss = self._train_step(x, y)
+                history.append(float(loss))
+                if verbose:
+                    print(f"epoch {ep} step {step}: loss {history[-1]:.6f}")
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1):
+        if self._eval_step is None:
+            raise RuntimeError("call prepare(loss=...) first")
+        losses = [float(self._eval_step(x, y))
+                  for x, y in self._batches(eval_data, batch_size)]
+        return float(np.mean(losses)) if losses else 0.0
+
+    def predict(self, test_data, batch_size: int = 1):
+        outs = []
+        for batch in self._batches(test_data, batch_size):
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outs.append(self._pred_step(x))
+        return outs
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def save(self, path: str, training: bool = True, mode=None):
+        from ...framework.io import save as fw_save
+
+        state = {"model": self.model.state_dict()}
+        if training and self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        fw_save(state, path)
+
+    def load(self, path: str, strict: bool = True, load_optimizer: bool = True,
+             mode=None):
+        from ...framework.io import load as fw_load
+
+        state = fw_load(path)
+        self.model.set_state_dict(state["model"])
+        if load_optimizer and self._optimizer is not None and \
+                "optimizer" in state:
+            self._optimizer.set_state_dict(state["optimizer"])

@@ -1,0 +1,9 @@
+"""paddle.incubate parity namespace (reference: python/paddle/incubate).
+
+Hosts pre-stable APIs: fused ops and the MoE/expert-parallel stack.  On TPU
+most of the reference's incubate fused CUDA ops are XLA fusions of the plain
+nn composition; the ones with a real memory/layout win live in ops.fused.
+"""
+from ..ops.fused import fused_linear_cross_entropy  # noqa: F401
+
+__all__ = ["fused_linear_cross_entropy"]

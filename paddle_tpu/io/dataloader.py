@@ -37,26 +37,42 @@ def get_worker_info():
     return _worker_info
 
 
-def default_collate_fn(batch):
-    """Stack samples into batched Tensors (reference: collate.py)."""
-    from ..core.tensor import Tensor, to_tensor
-
+def _collate(batch, leaf):
+    """Shared batch traversal; `leaf(ndarray) -> leaf value` decides whether
+    stacked arrays become Tensors (host path) or stay numpy (worker path)."""
     sample = batch[0]
-    if isinstance(sample, Tensor):
-        arrs = [np.asarray(s.numpy()) for s in batch]
-        return to_tensor(np.stack(arrs))
     if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
+        return leaf(np.stack(batch))
     if isinstance(sample, (int, np.integer)):
-        return to_tensor(np.asarray(batch, dtype=np.int64))
+        return leaf(np.asarray(batch, dtype=np.int64))
     if isinstance(sample, (float, np.floating)):
-        return to_tensor(np.asarray(batch, dtype=np.float32))
+        return leaf(np.asarray(batch, dtype=np.float32))
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
-        return [default_collate_fn(list(items)) for items in transposed]
+        return [_collate(list(items), leaf) for items in transposed]
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
-    return batch
+        return {k: _collate([d[k] for d in batch], leaf) for k in sample}
+    from ..core.tensor import Tensor
+
+    if isinstance(sample, Tensor):
+        return leaf(np.stack([np.asarray(s.numpy()) for s in batch]))
+    return batch  # unknown sample types pass through unbatched
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched Tensors (reference: collate.py)."""
+    from ..core.tensor import to_tensor
+
+    return _collate(batch, to_tensor)
+
+
+def numpy_collate_fn(batch):
+    """default_collate_fn's traversal producing numpy arrays only — the
+    worker-process collate.  Workers must NEVER create device arrays: the
+    axon TPU tunnel is single-client and force-registers itself in every
+    python process, so a child touching jax blocks forever waiting for the
+    device the parent owns (this exact deadlock shipped in round 2)."""
+    return _collate(batch, lambda a: a)
 
 
 def _fetch_batch(dataset, indices, collate_fn):
@@ -94,6 +110,15 @@ def _tensor_ify(obj):
 def _worker_loop(dataset, index_queue, result_queue, collate_fn,
                  worker_init_fn, worker_id, num_workers):
     global _worker_info
+    # Defense in depth against the single-client TPU tunnel (see
+    # numpy_collate_fn): if anything in this child does touch jax, make it
+    # initialize the CPU backend, not the device the parent holds.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
@@ -126,11 +151,14 @@ class _MultiProcessIter:
         self.next_yield = 0
         self.reorder = {}
         n = loader.num_workers
+        # workers get the numpy collate unless the user supplied one
+        wcollate = (numpy_collate_fn if loader.collate_fn
+                    is default_collate_fn else loader.collate_fn)
         for wid in range(n):
             iq = ctx.Queue()
             w = ctx.Process(
                 target=_worker_loop,
-                args=(loader.dataset, iq, self.result_queue, loader.collate_fn,
+                args=(loader.dataset, iq, self.result_queue, wcollate,
                       loader.worker_init_fn, wid, n),
                 daemon=True,
             )

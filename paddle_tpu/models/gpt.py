@@ -243,9 +243,18 @@ class GPTForCausalLM(Layer):
         vocab-parallel logits + ParallelCrossEntropy path.
         """
         from ..distributed import mesh as _mesh_mod
+        from ..distributed.fleet.meta_parallel.tensor_parallel import (
+            shard_batch,
+        )
         from ..ops.fused import fused_linear_cross_entropy
 
         m = _mesh_mod.get_global_mesh()
+        # same input placement the DataParallel wrapper's forward applies
+        # (callers reach this method through the wrapper's __getattr__)
+        input_ids = shard_batch(input_ids, m)
+        labels = shard_batch(labels, m)
+        if loss_mask is not None:
+            loss_mask = shard_batch(loss_mask, m)
         mp = m.shape.get(MODEL_AXIS, 1) if m is not None else 1
         if mp > 1:
             crit = GPTPretrainingCriterion(ignore_index=ignore_index)

@@ -1,0 +1,135 @@
+"""Semi-auto parallel API (reference: auto_parallel interface.py, engine.py,
+and the unittests under auto_parallel/): ProcessMesh topology, shard_tensor
+annotation → placement, Engine.fit distributed-vs-single-device loss
+equivalence on the 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import auto_parallel as ap
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    saved_mesh = mesh_mod.get_global_mesh()
+    saved_pm = ap._default_process_mesh
+    ap._default_process_mesh = None
+    mesh_mod.set_global_mesh(None)
+    yield
+    mesh_mod.set_global_mesh(saved_mesh)
+    ap._default_process_mesh = saved_pm
+
+
+class TestProcessMesh:
+    def test_topology(self):
+        pm = ap.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["x", "y"])
+        assert pm.topology == [2, 4]
+        assert pm.dim_names == ["x", "y"]
+        assert pm.processes == list(range(8))
+        assert pm.ndim == 2
+        m = pm.jax_mesh()
+        assert m.shape == {"x": 2, "y": 4}
+
+    def test_default_registration(self):
+        pm = ap.ProcessMesh([0, 1])
+        assert ap.get_default_process_mesh() is pm
+
+    def test_bad_dims(self):
+        with pytest.raises(ValueError):
+            ap.ProcessMesh([[0, 1]], dim_names=["a", "b", "c"])
+
+
+class TestShardTensor:
+    def test_dims_mapping_places_parameter(self):
+        pm = ap.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["x", "y"])
+        lin = nn.Linear(8, 16)
+        ap.shard_tensor(lin.weight,
+                        dist_attr={"process_mesh": pm,
+                                   "dims_mapping": [-1, 1]})
+        spec = lin.weight._value().sharding.spec
+        assert tuple(spec) == (None, "y")
+
+    def test_shard_spec_names(self):
+        pm = ap.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["x", "y"])
+        t = paddle.to_tensor(np.zeros((4, 8), dtype=np.float32))
+        out = ap.shard_tensor(t, process_mesh=pm, shard_spec=[None, "y"])
+        assert tuple(out._value().sharding.spec) == (None, "y")
+
+    def test_shard_op_constrains_output(self):
+        pm = ap.ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7], dim_names=["x"])
+        f = ap.shard_op(lambda a, b: a + b, process_mesh=pm,
+                        out_shard_specs=[["x"]])
+        a = paddle.to_tensor(np.ones((8, 2), dtype=np.float32))
+        out = f(a, a)
+        np.testing.assert_allclose(np.asarray(out.numpy()), 2.0)
+
+
+class TestEngine:
+    def _data(self, cfg, n=32, seq=8, seed=0):
+        rs = np.random.RandomState(seed)
+        xs = rs.randint(0, cfg.vocab_size, (n, seq)).astype(np.int64)
+        ys = rs.randint(0, cfg.vocab_size, (n, seq)).astype(np.int64)
+        return xs, ys
+
+    def _train(self, mesh_ids, dim_names, batch_size=8, inputs_spec=None):
+        from paddle_tpu.models import (
+            llama_tiny, LlamaForCausalLM, LlamaPretrainingCriterion)
+
+        paddle.seed(0)
+        cfg = llama_tiny()
+        model = LlamaForCausalLM(cfg)
+        if mesh_ids is not None:
+            pm = ap.ProcessMesh(mesh_ids, dim_names=dim_names)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        eng = ap.Engine(model, inputs_spec=inputs_spec)
+        eng.prepare(optimizer=opt, loss=LlamaPretrainingCriterion())
+        xs, ys = self._data(cfg)
+        return eng.fit((xs, ys), batch_size=batch_size, epochs=1,
+                       steps_per_epoch=4)
+
+    def test_fit_dp_matches_single_device(self):
+        ref = self._train(None, None)            # no mesh: single device
+        dist = self._train([0, 1, 2, 3, 4, 5, 6, 7], ["dp"],
+                           inputs_spec=["dp"])
+        np.testing.assert_allclose(ref, dist, rtol=2e-5, atol=2e-6)
+
+    def test_fit_mp_matches_single_device(self):
+        ref = self._train(None, None)
+        # mesh dim "model": Llama's parallel layers annotate over it
+        dist = self._train([[0, 1], [2, 3], [4, 5], [6, 7]],
+                           ["data", "model"], inputs_spec=["data"])
+        np.testing.assert_allclose(ref, dist, rtol=2e-5, atol=2e-6)
+
+    def test_engine_save_load(self, tmp_path):
+        from paddle_tpu.models import (
+            llama_tiny, LlamaForCausalLM, LlamaPretrainingCriterion)
+
+        paddle.seed(0)
+        cfg = llama_tiny()
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        eng = ap.Engine(model)
+        eng.prepare(optimizer=opt, loss=LlamaPretrainingCriterion())
+        xs, ys = self._data(cfg, n=8)
+        eng.fit((xs, ys), batch_size=4, epochs=1)
+        p = str(tmp_path / "ckpt")
+        eng.save(p)
+
+        paddle.seed(1)
+        model2 = LlamaForCausalLM(cfg)
+        eng2 = ap.Engine(model2)
+        eng2.prepare(
+            optimizer=paddle.optimizer.AdamW(
+                learning_rate=1e-3, parameters=model2.parameters()),
+            loss=LlamaPretrainingCriterion())
+        eng2.load(p)
+        l1 = eng.evaluate((xs, ys), batch_size=4)
+        l2 = eng2.evaluate((xs, ys), batch_size=4)
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
