@@ -13,6 +13,27 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Multi-controller bootstrap MUST precede any XLA backend use, and package
+# import touches the backend — so when the launcher's env contract
+# (distributed/launch) is present, wire up jax.distributed here, first.
+import os as _os
+
+if int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 \
+        and _os.environ.get("PADDLE_MASTER"):
+    import jax as _jax
+
+    try:  # idempotent: skip if a coordinator client already exists
+        from jax._src.distributed import global_state as _jds
+
+        _already = _jds.client is not None
+    except Exception:
+        _already = False
+    if not _already:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["PADDLE_MASTER"],
+            num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
+
 from .core import dtype as _dtype_mod
 from .core.dtype import (
     bfloat16, float16, float32, float64, int8, int16, int32, int64,

@@ -21,6 +21,8 @@ from . import fleet  # noqa: E402
 from . import sharding  # noqa: E402
 from . import auto_parallel  # noqa: E402
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op, Engine
+from . import checkpoint  # noqa: E402
+from .checkpoint import save_state_dict, load_state_dict
 from .sharding_spec import (
     mark_sharding, shard_parameter, set_param_spec, get_param_spec, batch_spec,
 )

@@ -12,13 +12,15 @@ over the data/sharding axes.  XLA then partitions the whole step.
 from __future__ import annotations
 
 import jax
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ....core.tensor import Tensor
 from ....nn.layer_base import Layer
 from ... import mesh as mesh_mod
 from ...sharding_spec import (
-    BATCH_AXES, SEQ_AXIS, get_param_spec, zero_spec, _filter_spec, _divisible,
+    BATCH_AXES, SEQ_AXIS, get_param_spec, place_array, zero_spec,
+    _filter_spec, _divisible,
 )
 
 
@@ -39,7 +41,7 @@ def place_parameters(layer: Layer, mesh=None, zero_params: bool = False,
             spec = _filter_spec(spec, m)
         if not _divisible(arr.shape, spec, m):
             spec = P()
-        t._set_data(jax.device_put(arr, NamedSharding(m, spec)))
+        t._set_data(place_array(arr, m, spec))
     return layer
 
 
@@ -57,6 +59,31 @@ def shard_batch(t, mesh=None, seq_dim=None, batch_axes=BATCH_AXES):
     if seq_dim is not None and arr.ndim > seq_dim and m.shape.get(SEQ_AXIS, 1) > 1:
         entries[seq_dim] = SEQ_AXIS
     spec = P(*entries)
+    if jax.process_count() > 1:
+        # multi-controller: `t` is this process's LOCAL batch shard (the
+        # reference's DistributedBatchSampler contract — each rank loads
+        # its own slice).  The global dim scales by how many processes the
+        # batch-sharded axes actually SPAN (their total extent over the
+        # local mesh extent) — not blindly by process_count: under pure
+        # model/sep parallelism the batch is replicated and local == global.
+        ns = NamedSharding(m, spec)
+        gshape = list(arr.shape)
+        for d, e in enumerate(spec):
+            if e is None:
+                continue
+            axes = e if isinstance(e, tuple) else (e,)
+            total = 1
+            local = 1
+            for a in axes:
+                total *= m.shape.get(a, 1)
+                local *= m.local_mesh.shape.get(a, 1)
+            gshape[d] = arr.shape[d] * (total // max(local, 1))
+        gshape = tuple(gshape)
+        if not _divisible(gshape, spec, m):
+            return t
+        ga = jax.make_array_from_process_local_data(ns, np.asarray(arr),
+                                                    gshape)
+        return Tensor._wrap(ga, stop_gradient=t.stop_gradient)
     if not _divisible(arr.shape, spec, m):
         return t
     out = Tensor._wrap(jax.device_put(arr, NamedSharding(m, spec)),

@@ -1,0 +1,188 @@
+"""``python -m paddle_tpu.distributed.launch`` — multi-process launcher with
+failure watching and restart.
+
+Reference parity: python/paddle/distributed/launch/main.py:18 (the `launch`
+CLI: collective mode, --nproc_per_node/--master/--nnodes, per-worker env +
+log files, proc watching) and fleet/elastic/manager.py:131 (watch loop,
+restart on worker failure).
+
+TPU-native notes: one launched process is one JAX *controller* that owns the
+host's local chips (multi-controller SPMD).  The launcher's env contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS /
+PADDLE_MASTER / PADDLE_CURRENT_ENDPOINT) is what
+``init_parallel_env`` (parallel.py) feeds into
+``jax.distributed.initialize`` — the TCPStore/NCCL-id rendezvous of the
+reference becomes JAX's coordinator service.  The watcher implements the
+elastic manager's restart semantics: if any local worker dies, the whole
+local set is torn down and relaunched with the same ranks (up to
+--max_restarts), which is exactly the recovery a fixed-topology TPU pod
+supports.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-process distributed launcher")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")))
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator host:port (default 127.0.0.1:<free>)")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="restarts after worker failure before giving up")
+    p.add_argument("--start_port", type=int,
+                   default=int(os.environ.get("PADDLE_START_PORT", "6170")))
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Worker:
+    def __init__(self, rank: int, cmd: List[str], env: dict,
+                 log_path: Optional[str]):
+        self.rank = rank
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_f = None
+
+    def start(self):
+        if self.log_path:
+            self._log_f = open(self.log_path, "ab")
+            out = self._log_f
+        else:
+            out = None
+        self.proc = subprocess.Popen(self.cmd, env=self.env, stdout=out,
+                                     stderr=subprocess.STDOUT if out else None)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+def _build_workers(args, master: str) -> List[_Worker]:
+    n_local = args.nproc_per_node
+    world = n_local * args.nnodes
+    host = master.split(":")[0]
+    endpoints = []
+    for node in range(args.nnodes):
+        for i in range(n_local):
+            endpoints.append(f"{host}:{args.start_port + node * n_local + i}")
+    workers = []
+    for i in range(n_local):
+        rank = args.node_rank * n_local + i
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(i),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_MASTER": master,
+            "FLAGS_selected_tpus": str(i),
+        })
+        cmd = [sys.executable, args.training_script] + \
+            list(args.training_script_args)
+        log = (os.path.join(args.log_dir, f"workerlog.{rank}")
+               if args.log_dir else None)
+        workers.append(_Worker(rank, cmd, env, log))
+    return workers
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    """Run the launcher; returns the exit code (0 = all workers OK)."""
+    args = _parse(argv)
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    master = args.master or f"127.0.0.1:{_free_port()}"
+
+    restarts = 0
+    while True:
+        workers = _build_workers(args, master)
+        for w in workers:
+            w.start()
+
+        def _forward(sig, _frame):
+            for w in workers:
+                w.terminate()
+            sys.exit(128 + sig)
+
+        old_int = signal.signal(signal.SIGINT, _forward)
+        old_term = signal.signal(signal.SIGTERM, _forward)
+        failed = None
+        try:
+            # watch loop (reference: elastic manager.watch, launch
+            # controller.pod watcher)
+            while True:
+                alive = False
+                for w in workers:
+                    rc = w.poll()
+                    if rc is None:
+                        alive = True
+                    elif rc != 0:
+                        failed = (w.rank, rc)
+                        break
+                if failed or not alive:
+                    break
+                time.sleep(0.2)
+        finally:
+            signal.signal(signal.SIGINT, old_int)
+            signal.signal(signal.SIGTERM, old_term)
+
+        if failed is None:
+            for w in workers:
+                w.terminate()
+            return 0
+
+        rank, rc = failed
+        print(f"[launch] worker rank {rank} exited with {rc}; "
+              f"tearing down peers", file=sys.stderr)
+        for w in workers:
+            w.terminate()
+        if restarts >= args.max_restarts:
+            print(f"[launch] giving up after {restarts} restarts",
+                  file=sys.stderr)
+            return rc if rc else 1
+        restarts += 1
+        # a fresh coordinator port avoids colliding with a half-dead one
+        master = args.master or f"127.0.0.1:{_free_port()}"
+        print(f"[launch] restart {restarts}/{args.max_restarts} "
+              f"(ranks preserved)", file=sys.stderr)
+
+
+def main():
+    sys.exit(launch())

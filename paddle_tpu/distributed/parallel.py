@@ -79,11 +79,12 @@ class DataParallel(Layer):
         self._replicate_params()
 
     def _replicate_params(self):
-        repl = NamedSharding(self._mesh, P())
+        from .sharding_spec import place_array
+
         for p in self._layers.parameters():
             arr = p._value()
             if isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer):
-                p._set_data(jax.device_put(arr, repl))
+                p._set_data(place_array(arr, self._mesh, P()))
 
     def forward(self, *inputs, **kwargs):
         from .fleet.meta_parallel.tensor_parallel import shard_batch

@@ -30,6 +30,10 @@ def group_sharded_parallel(model, optimizer, level: str = "os", scaler=None,
                            segment_size=None, sync_comm: bool = False):
     if level not in LEVELS:
         raise ValueError(f"level must be one of {LEVELS}, got {level!r}")
+    if offload:
+        raise NotImplementedError(
+            "offload=True (CPU optimizer state) is not supported on the TPU "
+            "backend; optimizer state lives sharded in HBM")
     mesh = mesh_mod.get_global_mesh()
     if mesh is None:
         # no fleet topology: treat all devices as one sharding axis
@@ -43,11 +47,17 @@ def group_sharded_parallel(model, optimizer, level: str = "os", scaler=None,
 
 
 def save_group_sharded_model(model, output, optimizer=None):
-    """Reference: group_sharded.py save helper — state is global arrays, so
-    a plain save captures the full (unsharded) state."""
-    from ...framework.io import save
+    """Reference: group_sharded.py save helper.  Writes a SHARDED
+    checkpoint (distributed/checkpoint.py): each process stores only its
+    local shards — no host-gather of full state (which at 13B/70B scale is
+    an OOM, not a checkpoint); load with
+    ``distributed.load_state_dict(path, model.state_dict())`` under any
+    topology."""
     import os
+
+    from ..checkpoint import save_state_dict
+
     os.makedirs(output, exist_ok=True)
-    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    save_state_dict(model.state_dict(), os.path.join(output, "model"))
     if optimizer is not None:
-        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))
+        save_state_dict(optimizer.state_dict(), os.path.join(output, "opt"))

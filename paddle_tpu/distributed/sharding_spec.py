@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
@@ -36,7 +37,12 @@ MODEL_AXIS = "model"
 
 
 def set_param_spec(param, spec: P):
-    param._pspec = spec
+    try:
+        param._pspec = spec
+    except AttributeError:
+        # plain (slotted) Tensors can't carry the annotation; placement
+        # still happens and the live spec is readable off the jax array
+        pass
     return param
 
 
@@ -139,6 +145,27 @@ def shard_parameter(param, spec: P, mesh: Optional[Mesh] = None):
     return param
 
 
+def place_array(arr, mesh: Mesh, spec: P):
+    """Place a host/local array under (mesh, spec) — multi-controller safe.
+
+    Single process: plain device_put.  Multi-controller (after
+    jax.distributed.initialize): device_put cannot target non-addressable
+    devices, so build the global array via make_array_from_callback — every
+    process holds the full value host-side and contributes the shards it
+    addresses."""
+    ns = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            # already a global array (second placement, checkpoint load):
+            # device-to-device reshard — no host fetch, which would raise
+            # on non-addressable shards
+            return jax.device_put(arr, ns)
+        host = np.asarray(arr)
+        return jax.make_array_from_callback(host.shape, ns,
+                                            lambda idx: host[idx])
+    return jax.device_put(arr, ns)
+
+
 def _place(p, spec: P, mesh: Mesh):
     arr = p._value()
     if isinstance(arr, jax.core.Tracer):
@@ -146,7 +173,7 @@ def _place(p, spec: P, mesh: Mesh):
     spec = _filter_spec(spec, mesh)
     if not _divisible(arr.shape, spec, mesh):
         spec = P()
-    p._set_data(jax.device_put(arr, NamedSharding(mesh, spec)))
+    p._set_data(place_array(arr, mesh, spec))
 
 
 def zero_spec(shape, spec: Optional[P], mesh: Mesh, axis: str = "sharding") -> P:

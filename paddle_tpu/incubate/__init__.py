@@ -5,5 +5,6 @@ most of the reference's incubate fused CUDA ops are XLA fusions of the plain
 nn composition; the ones with a real memory/layout win live in ops.fused.
 """
 from ..ops.fused import fused_linear_cross_entropy  # noqa: F401
+from . import distributed  # noqa: F401
 
-__all__ = ["fused_linear_cross_entropy"]
+__all__ = ["fused_linear_cross_entropy", "distributed"]

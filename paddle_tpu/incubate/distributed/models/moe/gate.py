@@ -1,0 +1,96 @@
+"""MoE gate networks (reference: python/paddle/incubate/distributed/models/
+moe/gate/{base_gate,naive_gate,gshard_gate,switch_gate}.py).
+
+Each gate maps tokens [N, H] → (top-k combine weights [N, k], expert ids
+[N, k]) and records a load-balancing auxiliary loss in ``self.loss``
+(reference: BaseGate.set_loss / get_loss).  All math is framework ops, so
+the aux loss is differentiable through the gate projection.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .....nn import functional as F
+from .....nn.initializer import Normal
+from .....nn.layer.common import Linear
+from .....nn.layer_base import Layer
+from ..... import ops
+
+
+class BaseGate(Layer):
+    def __init__(self, num_expert: int, top_k: int):
+        super().__init__()
+        self.num_expert = num_expert
+        self.top_k = top_k
+        self.loss = None
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self):
+        return self.loss
+
+
+class NaiveGate(BaseGate):
+    """Plain softmax-top-k routing, no auxiliary loss (reference:
+    naive_gate.py:29)."""
+
+    def __init__(self, d_model: int, num_expert: int, top_k: int = 2):
+        super().__init__(num_expert, top_k)
+        self.gate = Linear(d_model, num_expert,
+                           weight_attr=Normal(std=0.02))
+
+    def _scores(self, x):
+        return F.softmax(self.gate(x).astype("float32"), axis=-1)
+
+    def forward(self, x):
+        scores = self._scores(x)
+        val, idx = ops.topk(scores, self.top_k, axis=-1)
+        self.set_loss(None)
+        return val, idx
+
+
+def _aux_load_balance(scores, top1_idx, num_expert):
+    """GShard/Switch load-balancing loss: E * Σ_e mean_prob_e * frac_e,
+    where frac_e is the fraction of tokens whose first choice is e."""
+    me = scores.mean(axis=0)                                  # [E]
+    assigned = ops.one_hot(top1_idx.astype("int64"),
+                           num_expert).astype("float32")      # [N, E]
+    ce = assigned.mean(axis=0)                                # [E]
+    return (me * ce).sum() * num_expert
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balance aux loss and capacity awareness
+    (reference: gshard_gate.py:30)."""
+
+    def __init__(self, d_model: int, num_expert: int, top_k: int = 2,
+                 capacity=(1.2, 2.4), random_routing: bool = True):
+        if top_k != 2:
+            raise ValueError("GShardGate works with top_k=2")
+        super().__init__(d_model, num_expert, top_k)
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        scores = self._scores(x)
+        val, idx = ops.topk(scores, 2, axis=-1)
+        self.set_loss(_aux_load_balance(scores, idx[:, 0], self.num_expert))
+        return val, idx
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch routing with aux loss (reference: switch_gate.py:30)."""
+
+    def __init__(self, d_model: int, num_expert: int, top_k: int = 1,
+                 capacity=(1.2, 2.4)):
+        if top_k != 1:
+            raise ValueError("SwitchGate is top-1")
+        super().__init__(d_model, num_expert, top_k)
+        self.capacity = capacity
+
+    def forward(self, x):
+        scores = self._scores(x)
+        val, idx = ops.topk(scores, 1, axis=-1)
+        self.set_loss(_aux_load_balance(scores, idx[:, 0], self.num_expert))
+        return val, idx

@@ -1,0 +1,197 @@
+"""MoELayer — mixture-of-experts with expert parallelism.
+
+Reference parity: python/paddle/incubate/distributed/models/moe/
+moe_layer.py:384 (MoELayer over a moe_group; dispatch via the
+global_scatter/global_gather C++ collectives, moe_layer.py:96-245,
+paddle/fluid/operators/collective/global_scatter_op.cc:108).
+
+TPU-native design: the reference's count-exchange + ragged NCCL alltoall
+becomes a STATIC-shape capacity dispatch (the GShard construction — XLA
+needs static shapes, and fixed expert capacity is also what bounds memory):
+
+1. top-k expert choice per token (gate), positions within each expert's
+   queue by a priority-ordered cumulative count (first choices of all
+   tokens outrank second choices — GShard's priority rule);
+2. tokens scatter into a [E, C, H] buffer; tokens over capacity drop
+   (their combine weight contributes zero, like the reference's capacity
+   clamp in prune_gate_by_capacity);
+3. the buffer is sharding-constrained so the expert dim E lies on the
+   expert-parallel mesh axes — GSPMD emits the batch→expert all-to-all
+   that global_scatter performed explicitly;
+4. stacked experts run under jax.vmap over the expert dim (one MXU batch);
+5. outputs gather back by the same slots and combine with gate weights.
+
+The whole dispatch-compute-combine is one differentiable tape op; gradients
+flow to tokens, gate weights (through the combine weights and aux loss) and
+every expert parameter.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....core import autograd
+from .....core.dispatch import apply_op
+from .....core.tensor import Tensor
+from .....nn.layer_base import Layer
+from .....nn.layer.container import LayerList
+from .....distributed import mesh as mesh_mod
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+
+def _expert_leaves(layer: Layer) -> List[Tensor]:
+    leaves = [p for _, p in sorted(layer.named_parameters())]
+    leaves += [b for _, b in sorted(layer.named_buffers())]
+    return leaves
+
+
+def _apply_template(template: Layer, leaf_arrays, x_arr):
+    """Run template.forward over raw arrays by payload swap (same mechanism
+    as the pipeline schedule's stage body)."""
+    leaves = _expert_leaves(template)
+    saved = [(t, t._data) for t in leaves]
+    try:
+        for t, a in zip(leaves, leaf_arrays):
+            t._data = a
+        with autograd.no_grad():
+            out = template(Tensor._wrap(x_arr))
+    finally:
+        for t, a in saved:
+            t._data = a
+    return out._value() if isinstance(out, Tensor) else out
+
+
+def _ep_axes(mesh, num_expert: int):
+    """Mesh axes to lay the expert dim over: a dedicated 'expert' axis if
+    the mesh has one, else the DP axes (DeepSpeed-style EP=DP placement)."""
+    if mesh is None:
+        return None
+    for cand in (("expert",), ("data", "sharding"), ("data",)):
+        n = 1
+        for a in cand:
+            n *= mesh.shape.get(a, 1)
+        kept = tuple(a for a in cand if mesh.shape.get(a, 1) > 1)
+        if kept and num_expert % n == 0:
+            return kept
+    return None
+
+
+class MoELayer(Layer):
+    """See module docstring.  API mirrors reference moe_layer.py:384.
+
+    Args:
+        d_model: token width.
+        experts: list/LayerList of structurally-identical expert Layers
+            (the total expert count across the expert-parallel group).
+        gate: dict config ({"type": "gshard"|"switch"|"naive",
+            "top_k": int}) or a BaseGate instance.
+        moe_group / mp_group: accepted for API parity; on TPU the expert
+            placement is the mesh annotation from _ep_axes, not a process
+            group.
+        capacity_factor: per-expert queue size multiplier
+            (C = ceil(top_k * N / E * capacity_factor)).
+    """
+
+    def __init__(self, d_model: int, experts, gate=None, moe_group=None,
+                 mp_group=None, capacity_factor: float = 1.2, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = experts if isinstance(experts, LayerList) \
+            else LayerList(list(experts))
+        self.num_expert = len(self.experts)
+        self.capacity_factor = float(capacity_factor)
+        if gate is None:
+            gate = {"type": "gshard", "top_k": 2}
+        if isinstance(gate, dict):
+            kind = gate.get("type", "gshard")
+            top_k = gate.get("top_k", 2)
+            if kind == "naive":
+                gate = NaiveGate(d_model, self.num_expert, top_k=top_k)
+            elif kind == "gshard":
+                gate = GShardGate(d_model, self.num_expert, top_k=2)
+            elif kind == "switch":
+                gate = SwitchGate(d_model, self.num_expert, top_k=1)
+            else:
+                raise ValueError(f"unknown gate type {kind!r}")
+        if not isinstance(gate, BaseGate):
+            raise TypeError("gate must be a BaseGate or config dict")
+        self.gate = gate
+        self.top_k = gate.top_k
+        # stacked-leaf template for vmapped expert compute
+        self._template = self.experts[0]
+        from ..... distributed.fleet.meta_parallel.pp_schedule import (
+            structure_signature,
+        )
+        sig0 = structure_signature(self._template)
+        for e in self.experts:
+            if structure_signature(e) != sig0:
+                raise ValueError("experts must be structurally identical")
+
+    @property
+    def l_aux(self):
+        return self.gate.get_loss()
+
+    def forward(self, x):
+        orig_shape = x.shape
+        H = orig_shape[-1]
+        x2 = x.reshape([-1, H])
+        N = x2.shape[0]
+        E, K = self.num_expert, self.top_k
+        C = int(np.ceil(K * N / E * self.capacity_factor))
+        val, idx = self.gate(x2)                       # [N,K] f32 / int
+        mesh = mesh_mod.get_global_mesh()
+        ep = _ep_axes(mesh, E)
+        per_leaf = [_expert_leaves(e) for e in self.experts]
+        n_leaf = len(per_leaf[0])
+        flat = [t for leaves in per_leaf for t in leaves]
+
+        def primal(x_arr, val_arr, idx_arr, *leaf_arrays):
+            # ---- positions by GShard priority: all 1st choices first ----
+            idx_f = idx_arr.astype(jnp.int32).T.reshape(-1)        # [K*N]
+            onehot = (idx_f[:, None] == jnp.arange(E)[None, :])
+            pos_f = (jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+                     * onehot).sum(-1) - 1                          # [K*N]
+            keep = pos_f < C
+            slot = jnp.where(keep, idx_f * C + pos_f, E * C)       # drop→trash
+            tok = jnp.tile(jnp.arange(N), K)
+            # ---- scatter tokens into the expert buffer ------------------
+            buf = jnp.zeros((E * C + 1, H), x_arr.dtype)
+            buf = buf.at[slot].add(x_arr[tok])
+            ebuf = buf[:E * C].reshape(E, C, H)
+            if ep is not None:
+                ebuf = jax.lax.with_sharding_constraint(
+                    ebuf, NamedSharding(mesh, P(ep, None, None)))
+
+            # ---- vmapped stacked experts --------------------------------
+            stacked = []
+            for j in range(n_leaf):
+                s = jnp.stack([leaf_arrays[i * n_leaf + j]
+                               for i in range(E)], axis=0)
+                if ep is not None:
+                    s = jax.lax.with_sharding_constraint(
+                        s, NamedSharding(
+                            mesh, P(*( (ep,) + (None,) * (s.ndim - 1)))))
+                stacked.append(s)
+            eout = jax.vmap(
+                lambda leaves_e, xe: _apply_template(
+                    self._template, leaves_e, xe))(tuple(stacked), ebuf)
+
+            # ---- gather back + combine ----------------------------------
+            flat_out = jnp.concatenate(
+                [eout.reshape(E * C, H),
+                 jnp.zeros((1, H), eout.dtype)], axis=0)
+            y_f = flat_out[slot]                                    # [K*N,H]
+            w_f = (val_arr.astype(jnp.float32).T.reshape(-1)
+                   * keep.astype(jnp.float32))
+            y = (y_f.astype(jnp.float32) * w_f[:, None]) \
+                .reshape(K, N, H).sum(0)
+            return y.astype(x_arr.dtype)
+
+        out = apply_op("moe_dispatch_combine", primal, [x2, val, idx] + flat)
+        return out.reshape(orig_shape)

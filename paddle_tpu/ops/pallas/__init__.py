@@ -67,6 +67,21 @@ def flash_attention(query, key, value, attn_mask=None, dropout_p=0.0,
     p = dropout_p if training else 0.0
     key_arr = rng_mod.next_key() if p > 0.0 else None
 
+    if attn_mask is None and p == 0.0:
+        # context parallelism: with a live "sep" axis the sequence is
+        # sharded — run the ppermute ring instead of letting GSPMD
+        # all-gather K/V (ops/ring_attention.py; beyond-reference)
+        from ...distributed import mesh as _mesh_mod
+
+        _m = _mesh_mod.get_global_mesh()
+        if _m is not None and _m.shape.get("sep", 1) > 1 \
+                and query.shape[1] % _m.shape["sep"] == 0 \
+                and query.shape[1] == key.shape[1]:
+            from ..ring_attention import ring_flash_attention
+
+            return ring_flash_attention(query, key, value,
+                                        is_causal=is_causal, mesh=_m)
+
     if use_pallas() and attn_mask is None and p == 0.0:
         from .flash_attention_kernel import flash_attention_fused, supports
 

@@ -165,6 +165,39 @@ class Optimizer:
     def set_state_dict(self, state_dict):
         import numpy as np
 
+        # Saved accumulator keys carry the SAVING run's parameter names.
+        # Auto-generated names (linear_0.weight, …) restart per process, so
+        # a model built later in the same process gets different names; map
+        # saved param keys onto the current parameter list by position (the
+        # accumulator dict iterates in parameter order on both sides).
+        saved_pkeys = []
+        for k in state_dict:
+            if k.startswith("@"):
+                continue
+            pk = k.rsplit("/", 1)[0]
+            if pk not in saved_pkeys:
+                saved_pkeys.append(pk)
+        params = list(self._parameter_list or [])
+        cur_names = [self._param_key(p) for p in params]
+        remap = {}
+        if saved_pkeys and set(saved_pkeys) != set(cur_names) \
+                and len(saved_pkeys) == len(cur_names):
+            remap = dict(zip(saved_pkeys, cur_names))
+            # validate the positional pairing: every non-scalar saved
+            # accumulator must match its mapped parameter's shape — else
+            # this is a different model, not a renamed one
+            shapes = {self._param_key(p): tuple(p.shape) for p in params}
+            for k, v in state_dict.items():
+                if k.startswith("@"):
+                    continue
+                pk = remap[k.rsplit("/", 1)[0]]
+                vs = tuple(getattr(v, "shape", ()) or ())
+                if vs and vs != shapes[pk]:
+                    raise ValueError(
+                        f"optimizer state {k!r} (shape {vs}) does not fit "
+                        f"parameter {pk!r} (shape {shapes[pk]}); the saved "
+                        f"state appears to be for a different model")
+
         for k, v in state_dict.items():
             if k == "@global_step":
                 self._global_step = int(v)
@@ -174,6 +207,7 @@ class Optimizer:
                     self._learning_rate.set_state_dict(v)
                 continue
             pkey, aname = k.rsplit("/", 1)
+            pkey = remap.get(pkey, pkey)
             arr = v._value() if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
             self._accumulators.setdefault(pkey, {})[aname] = Tensor._wrap(arr)
 

@@ -1,0 +1,74 @@
+"""Worker script for the launcher tests: multi-controller DP training.
+
+Each process loads its OWN slice of the global batch (the
+DistributedBatchSampler contract), trains the same tiny model, and rank 0
+writes the loss history to PADDLE_TEST_OUT.  Run single-process (no
+PADDLE_* env) it trains on the full batch — the equivalence oracle.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+
+
+def main():
+    if os.environ.get("PADDLE_TEST_ALWAYS_FAIL"):
+        print("simulated unrecoverable failure", file=sys.stderr)
+        sys.exit(3)
+    fail_marker = os.environ.get("PADDLE_TEST_FAIL_MARKER")
+    if fail_marker and not os.path.exists(fail_marker):
+        # elastic-restart test: first generation dies, restart succeeds
+        open(fail_marker, "w").write("died once")
+        print("simulated worker failure", file=sys.stderr)
+        sys.exit(3)
+
+    penv = paddle.distributed.init_parallel_env()
+    rank = penv.rank
+    world = max(penv.world_size, 1)
+
+    rs = np.random.RandomState(0)
+    GLOBAL_B = 16
+    X = rs.randn(GLOBAL_B, 8).astype(np.float32)
+    W = rs.randn(8, 2).astype(np.float32)
+    Y = X @ W
+
+    local = GLOBAL_B // world
+    Xl, Yl = X[rank * local:(rank + 1) * local], \
+        Y[rank * local:(rank + 1) * local]
+
+    paddle.seed(0)
+    model = paddle.distributed.DataParallel(nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    from paddle_tpu.distributed.fleet.meta_parallel.tensor_parallel import (
+        shard_batch,
+    )
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = shard_batch(paddle.to_tensor(Xl))
+    y = shard_batch(paddle.to_tensor(Yl))
+    losses = [float(step(x, y)) for _ in range(10)]
+    out = os.environ.get("PADDLE_TEST_OUT")
+    if out and rank == 0:
+        with open(out, "w") as f:
+            json.dump(losses, f)
+    print("rank", rank, "losses", losses[0], losses[-1])
+
+
+if __name__ == "__main__":
+    main()

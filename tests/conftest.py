@@ -34,4 +34,25 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def pytest_configure(config):
-    config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test (skipped unless PADDLE_TPU_RUN_SLOW=1 or "
+        "--runslow)")
+
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="run tests marked slow")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    if config.getoption("--runslow") or \
+            os.environ.get("PADDLE_TPU_RUN_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(reason="slow; use --runslow or "
+                            "PADDLE_TPU_RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

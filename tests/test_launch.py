@@ -1,0 +1,65 @@
+"""paddle_tpu.distributed.launch: multi-process DP equivalence and the
+failure watcher (reference: distributed/launch/main.py, elastic/manager.py
+watch+restart)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tests", "assets", "launch_dp_train.py")
+
+
+def _run(args, env_extra, timeout=300):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "XLA_FLAGS", "JAX_"))}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    return subprocess.run(args, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+@pytest.mark.slow
+class TestLaunchDP:
+    def test_two_process_dp_matches_single(self, tmp_path):
+        single_out = str(tmp_path / "single.json")
+        r = _run([sys.executable, SCRIPT],
+                 {"PADDLE_TEST_OUT": single_out})
+        assert r.returncode == 0, r.stderr[-2000:]
+        multi_out = str(tmp_path / "multi.json")
+        r = _run([sys.executable, "-m", "paddle_tpu.distributed.launch",
+                  "--nproc_per_node", "2", SCRIPT],
+                 {"PADDLE_TEST_OUT": multi_out})
+        assert r.returncode == 0, r.stderr[-2000:]
+        single = json.load(open(single_out))
+        multi = json.load(open(multi_out))
+        np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-7)
+
+    def test_watcher_restarts_failed_worker(self, tmp_path):
+        marker = str(tmp_path / "died")
+        out = str(tmp_path / "out.json")
+        r = _run([sys.executable, "-m", "paddle_tpu.distributed.launch",
+                  "--nproc_per_node", "2", "--max_restarts", "1", SCRIPT],
+                 {"PADDLE_TEST_OUT": out,
+                  "PADDLE_TEST_FAIL_MARKER": marker})
+        assert "restart 1/1" in r.stderr, r.stderr[-2000:]
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert os.path.exists(out)
+
+    def test_watcher_gives_up_after_max_restarts(self, tmp_path):
+        r = _run([sys.executable, "-m", "paddle_tpu.distributed.launch",
+                  "--nproc_per_node", "1", "--max_restarts", "1", SCRIPT],
+                 {"PADDLE_TEST_ALWAYS_FAIL": "1"})
+        assert r.returncode == 3
+        assert "giving up" in r.stderr
+
+
+class TestLaunchCLI:
+    def test_module_entrypoint_help(self):
+        r = _run([sys.executable, "-m", "paddle_tpu.distributed.launch",
+                  "--help"], {})
+        assert r.returncode == 0
+        assert "nproc_per_node" in r.stdout

@@ -347,6 +347,7 @@ class TestTransformer:
         y = mha(x, attn_mask=mask)
         assert y.shape == [1, 4, 8]
 
+    @pytest.mark.slow
     def test_grad_through_attention(self):
         mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
         x = t(np.random.randn(2, 4, 8), sg=False)

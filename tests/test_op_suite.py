@@ -1,0 +1,314 @@
+"""The op-schema table driving the OpTest harness (reference: the per-op
+unittests generated around op_test.py — here one declarative row per op).
+
+Every row gets: forward-vs-numpy check, analytic-vs-numeric gradient check,
+dtype sweep, and (where declared) Tensor-method binding check.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_harness import Inp, OpSpec, check_dtypes, check_grad, check_method, \
+    check_output
+
+S = (3, 4)
+FLT = ("float32", "bfloat16")
+
+
+def _unary(name, ref, low=-1.0, high=1.0, positive=False, method=None,
+           grad=True, **kw):
+    return OpSpec(name, [Inp(S, low=low, high=high, positive=positive)],
+                  ref=ref, method=method or name, grad=grad, dtypes=FLT,
+                  **kw)
+
+
+def _binary(name, ref, method=None, positive=False, **kw):
+    return OpSpec(name, [Inp(S, positive=positive),
+                         Inp(S, positive=positive)],
+                  ref=ref, method=method or name, dtypes=FLT, **kw)
+
+
+SPECS = [
+    # ---- elementwise unary --------------------------------------------------
+    _unary("abs", np.abs, low=0.2, high=1.0),
+    _unary("exp", np.exp),
+    _unary("expm1", np.expm1),
+    _unary("log", np.log, positive=True),
+    _unary("log2", np.log2, positive=True),
+    _unary("log10", np.log10, positive=True),
+    _unary("log1p", np.log1p, positive=True),
+    _unary("sqrt", np.sqrt, positive=True),
+    _unary("rsqrt", lambda a: 1 / np.sqrt(a), positive=True),
+    _unary("square", np.square),
+    _unary("reciprocal", np.reciprocal, positive=True),
+    _unary("sin", np.sin),
+    _unary("cos", np.cos),
+    _unary("tan", np.tan, low=-0.5, high=0.5),
+    _unary("asin", np.arcsin, low=-0.8, high=0.8),
+    _unary("acos", np.arccos, low=-0.8, high=0.8),
+    _unary("atan", np.arctan),
+    _unary("sinh", np.sinh),
+    _unary("cosh", np.cosh),
+    _unary("tanh", np.tanh),
+    _unary("asinh", np.arcsinh),
+    _unary("acosh", np.arccosh, low=1.5, high=3.0),
+    _unary("atanh", np.arctanh, low=-0.8, high=0.8),
+    _unary("sigmoid", lambda a: 1 / (1 + np.exp(-a))),
+    _unary("erf", None),
+    _unary("lgamma", None, positive=True),
+    _unary("digamma", None, positive=True, grad=False),
+    _unary("floor", np.floor, grad=False),
+    _unary("ceil", np.ceil, grad=False),
+    _unary("round", np.round, grad=False),
+    _unary("trunc", np.trunc, grad=False),
+    _unary("frac", lambda a: a - np.trunc(a)),
+    _unary("sign", np.sign, grad=False),
+    _unary("neg", lambda a: -a),
+    _unary("deg2rad", np.deg2rad),
+    _unary("rad2deg", np.rad2deg),
+    OpSpec("scale", [Inp(S)], kwargs={"scale": 2.5, "bias": 0.5},
+           ref=lambda a, scale, bias: a * scale + bias, dtypes=FLT),
+    OpSpec("clip", [Inp(S)], kwargs={"min": -0.3, "max": 0.4},
+           ref=lambda a, min, max: np.clip(a, min, max), dtypes=FLT,
+           method="clip"),
+    OpSpec("nan_to_num", [Inp(S)], ref=np.nan_to_num, grad=False),
+    # ---- elementwise binary -------------------------------------------------
+    _binary("add", np.add),
+    _binary("subtract", np.subtract),
+    _binary("multiply", np.multiply),
+    _binary("divide", np.divide, positive=True),
+    _binary("pow", lambda a, b: np.power(a, b), positive=True),
+    _binary("maximum", np.maximum),
+    _binary("minimum", np.minimum),
+    _binary("fmax", np.fmax),
+    _binary("fmin", np.fmin),
+    _binary("mod", lambda a, b: np.mod(a, b), positive=True, grad=False),
+    _binary("floor_divide", lambda a, b: np.floor_divide(a, b),
+            positive=True, grad=False),
+    _binary("atan2", np.arctan2, positive=True),
+    _binary("hypot", np.hypot, positive=True),
+    _binary("logaddexp", np.logaddexp),
+    OpSpec("lerp", [Inp(S), Inp(S), Inp(S)],
+           ref=lambda a, b, w: a + w * (b - a), dtypes=FLT),
+    # ---- comparison / logic (forward-only) ----------------------------------
+    _binary("equal", np.equal, grad=False),
+    _binary("not_equal", np.not_equal, grad=False),
+    _binary("greater_than", np.greater, grad=False),
+    _binary("greater_equal", np.greater_equal, grad=False),
+    _binary("less_than", np.less, grad=False),
+    _binary("less_equal", np.less_equal, grad=False),
+    OpSpec("logical_and", [Inp(S, dtype="bool"), Inp(S, dtype="bool")],
+           ref=np.logical_and, grad=False),
+    OpSpec("logical_or", [Inp(S, dtype="bool"), Inp(S, dtype="bool")],
+           ref=np.logical_or, grad=False),
+    OpSpec("logical_xor", [Inp(S, dtype="bool"), Inp(S, dtype="bool")],
+           ref=np.logical_xor, grad=False),
+    OpSpec("logical_not", [Inp(S, dtype="bool")], ref=np.logical_not,
+           grad=False),
+    OpSpec("isnan", [Inp(S)], ref=np.isnan, grad=False),
+    OpSpec("isinf", [Inp(S)], ref=np.isinf, grad=False),
+    OpSpec("isfinite", [Inp(S)], ref=np.isfinite, grad=False),
+    OpSpec("bitwise_and", [Inp(S, dtype="int32"), Inp(S, dtype="int32")],
+           ref=np.bitwise_and, grad=False),
+    OpSpec("bitwise_or", [Inp(S, dtype="int32"), Inp(S, dtype="int32")],
+           ref=np.bitwise_or, grad=False),
+    OpSpec("bitwise_xor", [Inp(S, dtype="int32"), Inp(S, dtype="int32")],
+           ref=np.bitwise_xor, grad=False),
+    OpSpec("bitwise_not", [Inp(S, dtype="int32")], ref=np.bitwise_not,
+           grad=False),
+    # ---- reductions ---------------------------------------------------------
+    OpSpec("sum", [Inp(S)], ref=lambda a: np.sum(a), dtypes=FLT,
+           method="sum"),
+    OpSpec("mean", [Inp(S)], ref=lambda a: np.mean(a), dtypes=FLT,
+           method="mean"),
+    OpSpec("max", [Inp(S)], ref=lambda a: np.max(a), method="max"),
+    OpSpec("min", [Inp(S)], ref=lambda a: np.min(a), method="min"),
+    OpSpec("prod", [Inp(S, positive=True)], ref=lambda a: np.prod(a)),
+    OpSpec("amax", [Inp(S)], ref=lambda a: np.max(a)),
+    OpSpec("amin", [Inp(S)], ref=lambda a: np.min(a)),
+    OpSpec("logsumexp", [Inp(S)],
+           ref=lambda a: np.log(np.sum(np.exp(a)))),
+    OpSpec("std", [Inp(S)], ref=lambda a: np.std(a, ddof=1)),
+    OpSpec("var", [Inp(S)], ref=lambda a: np.var(a, ddof=1)),
+    OpSpec("median", [Inp((3, 5))], grad=False),
+    OpSpec("nanmean", [Inp(S)], ref=lambda a: np.nanmean(a), grad=False),
+    OpSpec("nansum", [Inp(S)], ref=lambda a: np.nansum(a), grad=False),
+    OpSpec("count_nonzero", [Inp(S)], grad=False),
+    OpSpec("all", [Inp(S, dtype="bool")], ref=lambda a: np.all(a),
+           grad=False),
+    OpSpec("any", [Inp(S, dtype="bool")], ref=lambda a: np.any(a),
+           grad=False),
+    OpSpec("cumsum", [Inp(S)], kwargs={"axis": 1},
+           ref=lambda a, axis: np.cumsum(a, axis=axis)),
+    OpSpec("cumprod", [Inp(S, positive=True)], kwargs={"dim": 1},
+           ref=lambda a, dim: np.cumprod(a, axis=dim)),
+    OpSpec("cummax", [Inp(S)], kwargs={"axis": 1}, grad=False),
+    # ---- linalg -------------------------------------------------------------
+    OpSpec("matmul", [Inp((3, 4)), Inp((4, 5))], ref=np.matmul,
+           method="matmul", dtypes=FLT),
+    OpSpec("mm", [Inp((3, 4)), Inp((4, 5))], ref=np.matmul, method="mm"),
+    OpSpec("bmm", [Inp((2, 3, 4)), Inp((2, 4, 5))], ref=np.matmul,
+           method="bmm"),
+    OpSpec("dot", [Inp((6,)), Inp((6,))], ref=np.dot, method="dot"),
+    OpSpec("mv", [Inp((3, 4)), Inp((4,))], ref=np.matmul, method="mv"),
+    OpSpec("inner", [Inp((3, 4)), Inp((5, 4))],
+           ref=lambda a, b: a @ b.T),
+    OpSpec("outer", [Inp((3,)), Inp((4,))], ref=np.outer),
+    OpSpec("t", [Inp((3, 4))], ref=lambda a: a.T, method="t"),
+    OpSpec("transpose", [Inp((2, 3, 4))], kwargs={"perm": [2, 0, 1]},
+           ref=lambda a, perm: np.transpose(a, perm), method="transpose"),
+    OpSpec("trace", [Inp((4, 4))], ref=lambda a: np.trace(a)),
+    OpSpec("norm", [Inp(S)],
+           ref=lambda a: np.linalg.norm(a.reshape(-1))),
+    OpSpec("dist", [Inp(S), Inp(S)],
+           ref=lambda a, b: np.linalg.norm((a - b).reshape(-1))),
+    OpSpec("kron", [Inp((2, 3)), Inp((3, 2))], ref=np.kron),
+    OpSpec("cross", [Inp((4, 3)), Inp((4, 3))],
+           ref=lambda a, b, axis: np.cross(a, b, axis=axis),
+           kwargs={"axis": 1}),
+    OpSpec("tril", [Inp((4, 4))], ref=np.tril, method="tril"),
+    OpSpec("triu", [Inp((4, 4))], ref=np.triu, method="triu"),
+    OpSpec("diag", [Inp((4,))], ref=np.diag),
+    # ---- manipulation -------------------------------------------------------
+    OpSpec("reshape", [Inp(S)], kwargs={"shape": [4, 3]},
+           ref=lambda a, shape: a.reshape(shape), method="reshape"),
+    OpSpec("flatten", [Inp((2, 3, 4))],
+           ref=lambda a: a.reshape(2, -1) if False else a.reshape(-1),
+           method="flatten"),
+    OpSpec("squeeze", [Inp((3, 1, 4))],
+           ref=lambda a: np.squeeze(a), method="squeeze"),
+    OpSpec("unsqueeze", [Inp(S)], kwargs={"axis": 1},
+           ref=lambda a, axis: np.expand_dims(a, axis), method="unsqueeze"),
+    OpSpec("tile", [Inp(S)], kwargs={"repeat_times": [2, 1]},
+           ref=lambda a, repeat_times: np.tile(a, repeat_times)),
+    OpSpec("broadcast_to", [Inp((1, 4))], kwargs={"shape": [3, 4]},
+           ref=lambda a, shape: np.broadcast_to(a, shape)),
+    OpSpec("expand", [Inp((1, 4))], kwargs={"shape": [3, 4]},
+           ref=lambda a, shape: np.broadcast_to(a, shape)),
+    OpSpec("flip", [Inp(S)], kwargs={"axis": 1},
+           ref=lambda a, axis: np.flip(a, axis)),
+    OpSpec("roll", [Inp(S)], kwargs={"shifts": 1, "axis": 0},
+           ref=lambda a, shifts, axis: np.roll(a, shifts, axis)),
+    OpSpec("rot90", [Inp(S)], ref=lambda a: np.rot90(a), grad=False),
+    OpSpec("moveaxis", [Inp((2, 3, 4))],
+           kwargs={"source": 0, "destination": 2},
+           ref=lambda a, source, destination:
+           np.moveaxis(a, source, destination)),
+    OpSpec("swapaxes", [Inp((2, 3, 4))], kwargs={"axis0": 0, "axis1": 2},
+           ref=lambda a, axis0, axis1: np.swapaxes(a, axis0, axis1)),
+    OpSpec("concat", [Inp(S)], fn=lambda a: paddle.concat([a, a], axis=0),
+           ref=lambda a: np.concatenate([a, a], axis=0)),
+    OpSpec("stack", [Inp(S)], fn=lambda a: paddle.stack([a, a], axis=0),
+           ref=lambda a: np.stack([a, a], axis=0)),
+    OpSpec("split", [Inp((4, 6))],
+           fn=lambda a: paddle.split(a, 2, axis=1),
+           ref=lambda a: tuple(np.split(a, 2, axis=1))),
+    OpSpec("chunk", [Inp((4, 6))],
+           fn=lambda a: paddle.chunk(a, 3, axis=1),
+           ref=lambda a: tuple(np.split(a, 3, axis=1))),
+    OpSpec("unbind", [Inp((3, 4))],
+           fn=lambda a: paddle.unbind(a, axis=0),
+           ref=lambda a: tuple(a[i] for i in range(3))),
+    OpSpec("gather", [Inp((5, 3)), Inp((3,), dtype="int32", int_high=5)],
+           ref=lambda a, i: a[i]),
+    OpSpec("index_select", [Inp((5, 3)),
+                            Inp((3,), dtype="int32", int_high=5)],
+           ref=lambda a, i: a[i]),
+    OpSpec("take_along_axis",
+           [Inp((4, 5)), Inp((4, 2), dtype="int64", int_high=5)],
+           kwargs={"axis": 1},
+           ref=lambda a, i, axis: np.take_along_axis(a, i, axis)),
+    OpSpec("masked_fill", [Inp(S), Inp(S, dtype="bool")],
+           kwargs={"value": 0.5},
+           ref=lambda a, m, value: np.where(m, value, a)),
+    OpSpec("where", [Inp(S, dtype="bool"), Inp(S), Inp(S)],
+           ref=np.where),
+    OpSpec("pad", [Inp((3, 4))], kwargs={"pad": [1, 1, 0, 2]},
+           grad=True),
+    OpSpec("one_hot", [Inp((5,), dtype="int64", int_high=4)],
+           kwargs={"num_classes": 4},
+           ref=lambda a, num_classes: np.eye(num_classes)[a],
+           grad=False),
+    OpSpec("repeat_interleave", [Inp((3, 2))], kwargs={"repeats": 2},
+           grad=False),
+    # ---- search / sort ------------------------------------------------------
+    OpSpec("argmax", [Inp(S)], ref=lambda a: np.argmax(a), grad=False),
+    OpSpec("argmin", [Inp(S)], ref=lambda a: np.argmin(a), grad=False),
+    OpSpec("argsort", [Inp((7,))], ref=np.argsort, grad=False),
+    OpSpec("sort", [Inp((7,))], ref=np.sort),
+    OpSpec("topk", [Inp((8,))], kwargs={"k": 3},
+           ref=lambda a, k: (np.sort(a)[::-1][:k].copy(),
+                             np.argsort(-a)[:k].copy())),
+    OpSpec("kthvalue", [Inp((8,))], kwargs={"k": 2}, grad=False),
+    OpSpec("nonzero", [Inp(S, dtype="bool")], grad=False),
+    OpSpec("searchsorted", [Inp((6,), low=0, high=1),
+                            Inp((4,), low=0, high=1)], grad=False,
+           fn=lambda a, v: paddle.searchsorted(paddle.sort(a), v)),
+    OpSpec("unique", [Inp((8,), dtype="int32", int_high=4)], grad=False),
+    # ---- activations (nn.functional) ----------------------------------------
+    OpSpec("relu", [Inp(S)], ref=lambda a: np.maximum(a, 0), dtypes=FLT),
+    OpSpec("gelu", [Inp(S)], dtypes=FLT),
+    OpSpec("silu", [Inp(S)], ref=lambda a: a / (1 + np.exp(-a))),
+    OpSpec("softmax", [Inp(S)],
+           ref=lambda a: np.exp(a) / np.exp(a).sum(-1, keepdims=True)),
+    OpSpec("log_softmax", [Inp(S)],
+           ref=lambda a: a - a.max(-1, keepdims=True) - np.log(
+               np.exp(a - a.max(-1, keepdims=True)).sum(-1, keepdims=True))),
+    OpSpec("leaky_relu", [Inp(S)],
+           ref=lambda a: np.where(a > 0, a, 0.01 * a)),
+    OpSpec("elu", [Inp(S)],
+           ref=lambda a: np.where(a > 0, a, np.exp(a) - 1)),
+    OpSpec("softplus", [Inp(S)], ref=lambda a: np.log1p(np.exp(a))),
+    OpSpec("hardtanh", [Inp(S)], ref=lambda a: np.clip(a, -1, 1)),
+    OpSpec("relu6", [Inp(S)], ref=lambda a: np.clip(a, 0, 6)),
+    OpSpec("mish", [Inp(S)]),
+    OpSpec("hardswish", [Inp(S)]),
+    OpSpec("hardsigmoid", [Inp(S)]),
+    OpSpec("selu", [Inp(S)]),
+    OpSpec("softsign", [Inp(S)], ref=lambda a: a / (1 + np.abs(a))),
+    OpSpec("tanhshrink", [Inp(S)], ref=lambda a: a - np.tanh(a)),
+    OpSpec("hardshrink", [Inp(S)]),
+    OpSpec("softshrink", [Inp(S)]),
+    # ---- losses -------------------------------------------------------------
+    OpSpec("mse_loss", [Inp(S), Inp(S)],
+           ref=lambda a, b: np.mean((a - b) ** 2)),
+    OpSpec("l1_loss", [Inp(S), Inp(S)],
+           ref=lambda a, b: np.mean(np.abs(a - b))),
+    OpSpec("smooth_l1_loss", [Inp(S), Inp(S)]),
+    OpSpec("kl_div", [Inp(S, low=-3, high=-0.5), Inp(S, positive=True)],
+           grad_rtol=5e-2),
+    OpSpec("binary_cross_entropy",
+           [Inp(S, low=0.1, high=0.9), Inp(S, low=0.1, high=0.9)]),
+    OpSpec("binary_cross_entropy_with_logits", [Inp(S), Inp(S, low=0, high=1)]),
+    OpSpec("square_error_cost", [Inp(S), Inp(S)],
+           ref=lambda a, b: (a - b) ** 2),
+    OpSpec("log_loss", [Inp(S, low=0.1, high=0.9),
+                        Inp(S, low=0.1, high=0.9)]),
+]
+
+_IDS = [s.name for s in SPECS]
+assert len(set(_IDS)) == len(_IDS), "duplicate op enrollment"
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=_IDS)
+class TestOpSuite:
+    def test_forward(self, spec):
+        check_output(spec)
+
+    def test_grad(self, spec):
+        if not spec.grad:
+            pytest.skip("op not differentiable / grad unchecked")
+        check_grad(spec)
+
+    def test_dtypes(self, spec):
+        check_dtypes(spec)
+
+    def test_method_binding(self, spec):
+        if spec.method is None:
+            pytest.skip("no tensor method declared")
+        check_method(spec)
+
+
+def test_enrollment_count():
+    assert len(SPECS) >= 100, f"only {len(SPECS)} ops enrolled"

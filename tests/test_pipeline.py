@@ -59,6 +59,7 @@ class TestPipelineSchedule:
         np.testing.assert_allclose(model(x).numpy(), pipe(x).numpy(),
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_sequential(self, hybrid_pp):
         pipe, model = _build(hybrid_pp)
         rs = np.random.RandomState(1)
@@ -98,6 +99,7 @@ class TestPipelineSchedule:
         with pytest.raises(ValueError):
             model(x)
 
+    @pytest.mark.slow
     def test_gpt_pipe_model(self, hybrid_pp):
         hcg, _ = hybrid_pp
         from paddle_tpu.models import gpt_tiny, GPTForCausalLMPipe
@@ -112,6 +114,7 @@ class TestPipelineSchedule:
                                    atol=2e-5)
 
 
+@pytest.mark.slow
 class TestJaxSwitchVmaAD:
     """Pins the jax 0.9.0 bug that forced the non-uniform pipeline schedule
     to stay sequential: lax.switch under shard_map varying-manual-axes
