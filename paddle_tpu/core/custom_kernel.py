@@ -1,0 +1,101 @@
+"""Custom op / custom kernel registration — the out-of-tree extension point.
+
+Reference parity: paddle/phi/core/custom_kernel.h:49 (out-of-tree kernels
+registered into the factory for existing ops) and
+python/paddle/utils/cpp_extension (user-defined ops compiled and bound).
+
+TPU-native design: a "kernel" is a pure jax-traceable function — typically a
+Pallas TPU kernel, but any jax composition works.  Two registration forms:
+
+- ``register_op(name, fn, vjp=None)``: a NEW op.  It enters the same
+  ``apply_op`` dispatch as built-ins (tape recording, AMP hook, nan/inf
+  sentinel all apply); ``vjp`` installs a custom gradient; optionally binds
+  a Tensor method.  This replaces the reference's compile-a-.so flow —
+  there is nothing to compile, XLA/Mosaic does it at trace time.
+- ``register_kernel(op_name, fn, backend=None)``: override the primal of an
+  EXISTING op for a backend (e.g. hand-written Pallas softmax on "tpu"
+  while other backends keep the stock path) — custom_kernel.h's semantics.
+  Dispatch consults the override table on every apply_op call.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+
+__all__ = ["register_op", "register_kernel", "unregister_kernel",
+           "get_kernel_override"]
+
+# (op_name, backend_or_None) -> primal fn
+_KERNELS: Dict[Tuple[str, Optional[str]], Callable] = {}
+
+
+def register_kernel(op_name: str, fn: Callable = None, *,
+                    backend: Optional[str] = None):
+    """Install `fn` as the kernel for `op_name` (optionally only on
+    `backend`, e.g. "tpu"/"cpu").  Usable as a decorator::
+
+        @register_kernel("softmax", backend="tpu")
+        def fast_softmax(x, axis=-1): ...
+    """
+    def _do(f):
+        _KERNELS[(op_name, backend)] = f
+        return f
+
+    if fn is None:
+        return _do
+    return _do(fn)
+
+
+def unregister_kernel(op_name: str, backend: Optional[str] = None):
+    _KERNELS.pop((op_name, backend), None)
+
+
+def get_kernel_override(op_name: str) -> Optional[Callable]:
+    if not _KERNELS:
+        return None
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = None
+    return _KERNELS.get((op_name, backend)) or _KERNELS.get((op_name, None))
+
+
+def register_op(name: str, fn: Callable, vjp: Optional[Callable] = None,
+                tensor_method: bool = False, n_outs: int = 1) -> Callable:
+    """Create a new framework op from a jax-level function.
+
+    ``fn(*arrays, **kwargs) -> array(s)``.  With ``vjp``, the pair is wired
+    as ``jax.custom_vjp`` (``vjp(residual_inputs, cotangents) -> input
+    cotangents``: signature ``vjp(primal_args_tuple, out_grads) -> tuple``).
+    Returns the Tensor-level callable (also reachable via
+    ``get_kernel_override`` dispatch if name collides with a built-in).
+    """
+    from .dispatch import apply_op
+
+    kernel = fn
+    if vjp is not None:
+        @jax.custom_vjp
+        def kernel(*arrays, **kwargs):
+            return fn(*arrays, **kwargs)
+
+        def _fwd(*arrays, **kwargs):
+            return fn(*arrays, **kwargs), arrays
+
+        def _bwd(res, g):
+            out = vjp(res, g)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        kernel.defvjp(_fwd, _bwd)
+
+    @functools.wraps(fn)
+    def op_fn(*tensors, **kwargs):
+        return apply_op(name, kernel, list(tensors), kwargs, n_outs=n_outs)
+
+    register_kernel(name, kernel)
+    if tensor_method:
+        from .tensor import register_tensor_method
+
+        register_tensor_method(name, op_fn)
+    return op_fn

@@ -131,3 +131,80 @@ def device_count(kind: Optional[str] = None) -> int:
     if kind is None:
         kind = current_place().kind
     return len(_devices_by_kind(kind))
+
+
+# ---------------------------------------------------------------------------
+# Memory statistics (reference: paddle/fluid/memory/stats.cc surfaced as
+# paddle.device.cuda.max_memory_allocated etc.).  On TPU the allocator is
+# XLA's (BFC on HBM); PJRT exposes its counters via Device.memory_stats().
+# ---------------------------------------------------------------------------
+
+def _resolve_device(device=None) -> jax.Device:
+    if isinstance(device, jax.Device):
+        return device
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, Place):
+        return _jax_device_for(device.kind, device.index or 0)
+    return jax.devices()[0]
+
+
+def memory_stats(device=None) -> dict:
+    """Raw allocator counters for one device (PJRT memory_stats; {} when
+    the backend exposes none, e.g. CPU)."""
+    return _resolve_device(device).memory_stats() or {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference:
+    paddle.device.cuda.memory_allocated)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes allocated (reference: cuda.max_memory_allocated)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (reference:
+    cuda.memory_reserved); 0 when the backend doesn't expose pool
+    counters (counters like bytes_limit describe CAPACITY, not
+    reservations, and must not be reported here)."""
+    return int(memory_stats(device).get("pool_bytes", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_pool_bytes", s.get("pool_bytes", 0)))
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device finished (reference:
+    paddle.device.cuda.synchronize)."""
+    import jax.numpy as jnp
+
+    d = _resolve_device(device)
+    jax.device_put(jnp.zeros(()), d).block_until_ready()
+
+
+class _AcceleratorNamespace:
+    """paddle.device.tpu.* — the accelerator-scoped stats API (the
+    reference's paddle.device.cuda.* shape)."""
+
+    memory_stats = staticmethod(memory_stats)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    synchronize = staticmethod(synchronize)
+
+    @staticmethod
+    def device_count() -> int:
+        return len(_devices_by_kind("tpu"))
+
+
+tpu = _AcceleratorNamespace()
+# source compatibility for reference code reaching for .cuda on an
+# accelerator: same counters, backed by the TPU/PJRT allocator
+cuda = tpu

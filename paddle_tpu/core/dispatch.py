@@ -60,6 +60,12 @@ def apply_op(
     if _amp_cast_hook is not None:
         tensor_args = _amp_cast_hook(name, tensor_args)
 
+    from .custom_kernel import get_kernel_override
+
+    _override = get_kernel_override(name)
+    if _override is not None:
+        primal = _override
+
     arrays = [_unwrap(a) for a in tensor_args]
 
     diff_idx: List[int] = []
@@ -106,13 +112,21 @@ def _wrap_outs(name, out, n_outs, stop_gradient):
 
 
 def _check_nan_inf(name, out):
-    """FLAGS_check_nan_inf parity (reference: details/nan_inf_utils_detail.cc)."""
+    """FLAGS_check_nan_inf parity (reference: details/nan_inf_utils_detail.cc
+    for the host scan; .cu for the in-graph scan — see core/error_guard)."""
     outs = out if isinstance(out, (tuple, list)) else (out,)
     for o in outs:
+        if isinstance(o, jax.core.Tracer):
+            # compiled path: arm an in-graph sentinel; the trace runtime
+            # raises after the step (error_guard.raise_on_error)
+            from . import error_guard
+
+            error_guard.set_error_if_nonfinite(name, o)
+            continue
         try:
             a = np.asarray(o)
         except Exception:
-            return  # tracer: skip under jit
+            continue
         if a.dtype.kind in "fc" and not np.isfinite(a).all():
             raise FloatingPointError(f"Operator {name} output contains NaN/Inf")
 

@@ -1,0 +1,55 @@
+"""In-graph NaN/Inf sentinel — FLAGS_check_nan_inf under jit.
+
+Reference parity: paddle/fluid/framework/details/nan_inf_utils_detail.cu —
+the reference scans every kernel output on-device when FLAGS_check_nan_inf
+is set, including inside graphs.  The eager path here is a host-side scan
+(core/dispatch._check_nan_inf); THIS module is the compiled path: at
+dispatch time each traced float output gets a `set_error_if` predicate
+(jax error_check threads an error-state value through the jitted program),
+and the trace runtime calls `raise_on_error()` after every compiled step —
+so a NaN born inside an XLA program surfaces as a FloatingPointError naming
+the producing op, exactly like eager.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax 0.9 ships this as jax._src.error_check (pre-public API)
+    from jax._src.error_check import (
+        JaxValueError, raise_if_error, set_error_if,
+    )
+
+    _AVAILABLE = True
+except Exception:  # pragma: no cover - older/newer jax layouts
+    _AVAILABLE = False
+
+
+def available() -> bool:
+    return _AVAILABLE
+
+
+def set_error_if_nonfinite(name: str, arr) -> None:
+    """Arm the sentinel for one traced op output (no-op for non-floats)."""
+    if not _AVAILABLE:
+        return
+    import jax.numpy as jnp
+
+    try:
+        kind = np.dtype(arr.dtype).kind
+    except Exception:
+        return
+    if kind not in "fc":
+        return
+    set_error_if(jnp.logical_not(jnp.all(jnp.isfinite(arr))),
+                 f"Operator {name} output contains NaN/Inf")
+
+
+def raise_on_error() -> None:
+    """Raise FloatingPointError if any armed sentinel fired since the last
+    check (call after running a compiled step)."""
+    if not _AVAILABLE:
+        return
+    try:
+        raise_if_error()
+    except JaxValueError as e:
+        raise FloatingPointError(str(e)) from None

@@ -43,13 +43,18 @@ def define_flag(name: str, default, help: str = "", on_change=None):
     return value
 
 
+def _norm(name: str) -> str:
+    """Public API accepts the reference's 'FLAGS_'-prefixed names."""
+    return name[6:] if name.startswith("FLAGS_") else name
+
+
 def get_flags(names=None) -> Dict[str, Any]:
     with _lock:
         if names is None:
             return {k: f.value for k, f in _registry.items()}
         if isinstance(names, str):
             names = [names]
-        return {n: _registry[n].value for n in names}
+        return {n: _registry[_norm(n)].value for n in names}
 
 
 def get_flag(name: str):
@@ -59,6 +64,7 @@ def get_flag(name: str):
 def set_flags(flags: Dict[str, Any]):
     with _lock:
         for name, val in flags.items():
+            name = _norm(name)
             if name not in _registry:
                 raise KeyError(f"unknown flag {name!r}")
             f = _registry[name]

@@ -62,24 +62,29 @@ def _from_disk_view(a: np.ndarray, dtype_name: str) -> np.ndarray:
 
 def _esc(key) -> str:
     """Escape a container key for use in a '/'-separated path (optimizer
-    state keys legitimately contain '/')."""
-    return str(key).replace("%", "%25").replace("/", "%2F")
+    state keys legitimately contain '/'; '<' guards the list-index
+    markers)."""
+    return (str(key).replace("%", "%25").replace("/", "%2F")
+            .replace("<", "%3C"))
 
 
 def _unesc(seg: str) -> str:
-    return seg.replace("%2F", "/").replace("%25", "%")
+    return seg.replace("%3C", "<").replace("%2F", "/").replace("%25", "%")
 
 
 def _flatten(obj, prefix=""):
     """Flatten a nested state container to {path: leaf}; '/' separates
-    nesting levels, literal '/' in keys is %-escaped."""
+    nesting levels, literal '/' in keys is %-escaped.  List/tuple indices
+    are marked ``<i>``/``<i!t>`` so containers round-trip with their type
+    (a dict key can never collide: '<' is %-escaped by _esc)."""
     out = {}
     if isinstance(obj, dict):
         for k, v in obj.items():
             out.update(_flatten(v, f"{prefix}{_esc(k)}/"))
     elif isinstance(obj, (list, tuple)):
+        tag = "!t" if isinstance(obj, tuple) else ""
         for i, v in enumerate(obj):
-            out.update(_flatten(v, f"{prefix}{i}/"))
+            out.update(_flatten(v, f"{prefix}<{i}{tag}>/"))
     else:
         out[prefix[:-1]] = obj
     return out
@@ -111,12 +116,32 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     """
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
-    index: Dict[str, Any] = {"tensors": {}, "format": 1}
-    pending: List[tuple] = []
     pid = jax.process_index()
+    nproc = jax.process_count()
+    # save generation: shard files carry it, so overwriting a live
+    # checkpoint directory never touches the files the CURRENT index
+    # references — the old checkpoint stays valid until the new index
+    # commits, then the old generation is garbage-collected
+    sid = 0
+    idx_path = os.path.join(path, _INDEX)
+    if os.path.exists(idx_path):
+        try:
+            with open(idx_path) as f:
+                sid = int(json.load(f).get("save_id", -1)) + 1
+        except Exception:
+            sid = 1
+    if nproc > 1:
+        from jax.experimental import multihost_utils as mhu
+
+        mhu.sync_global_devices("ckpt_sid")  # all read sid before writes
+    index: Dict[str, Any] = {"tensors": {}, "format": 1, "save_id": sid}
+    pending: List[tuple] = []
 
     for name, value in flat.items():
-        safe = name.replace("/", "__")
+        # injective filename encoding ('%' first, then '/'): distinct
+        # tensor paths can never collide on disk
+        safe = (name.replace("%", "%25").replace("/", "%2F")
+                + f".s{sid}")
         if not isinstance(value, (Tensor, np.ndarray, jax.Array)) \
                 and np.ndim(value) == 0 and not isinstance(value, np.generic):
             # python scalars/strings (step counters, config) go straight
@@ -158,21 +183,12 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         index["tensors"][name] = meta
 
     def _commit():
-        """Write the index LAST — it is the checkpoint's commit marker.
-        A crash mid-save therefore leaves no index.json and readers never
-        see a half-written checkpoint.  Multi-host: barriers bracket the
-        fragment exchange so no process merges before every peer has
-        written, and stale fragments from a prior save are cleaned first."""
-        nproc = jax.process_count()
-        if nproc > 1:
-            from jax.experimental import multihost_utils as mhu
-
-            if pid == 0:
-                for fn in os.listdir(path):
-                    if fn.startswith("_index.") or fn == _INDEX:
-                        os.remove(os.path.join(path, fn))
-            mhu.sync_global_devices("ckpt_clean")
-        frag = os.path.join(path, f"_index.{pid}.json")
+        """Commit protocol: data files (generation-tagged) land first, the
+        index replaces atomically LAST, old-generation files are GC'd
+        after.  A crash at any point leaves either the previous checkpoint
+        fully intact (index not yet replaced) or the new one committed
+        with some stale-but-unreferenced files (harmless)."""
+        frag = os.path.join(path, f"_index.{pid}.{sid}.json")
         with open(frag, "w") as f:
             json.dump(index, f)
         if nproc > 1:
@@ -182,7 +198,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         if pid == 0:
             merged = index
             for p in range(nproc):
-                fp = os.path.join(path, f"_index.{p}.json")
+                fp = os.path.join(path, f"_index.{p}.{sid}.json")
                 if p == pid:
                     continue
                 if not os.path.exists(fp):
@@ -200,6 +216,26 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             with open(tmp, "w") as f:
                 json.dump(merged, f, indent=1)
             os.replace(tmp, os.path.join(path, _INDEX))
+        if nproc > 1:
+            from jax.experimental import multihost_utils as mhu
+
+            mhu.sync_global_devices("ckpt_commit")
+        # GC generations older than the committed one (each process owns
+        # its shard files; process 0 owns .full files and fragments)
+        cur = f".s{sid}"
+        for fn in os.listdir(path):
+            full = os.path.join(path, fn)
+            try:
+                if fn.startswith("_index.") and not fn.endswith(
+                        f".{sid}.json") and fn.split(".")[1] == str(pid):
+                    os.remove(full)
+                elif fn.endswith(".npy") and cur not in fn:
+                    mine = (f".{pid}." in fn) or \
+                        (pid == 0 and ".full" in fn)
+                    if mine:
+                        os.remove(full)
+            except OSError:
+                pass
 
     def _write():
         for fpath, data in pending:
@@ -362,12 +398,35 @@ def load_state_dict(path: str, state_dict: Optional[Dict[str, Any]] = None,
     return _unflatten(out_flat)
 
 
+import re as _re
+
+_IDX_RE = _re.compile(r"^<(\d+)(!t)?>$")
+
+
 def _unflatten(flat: Dict[str, Any]):
+    # build the tree on ESCAPED keys (index markers are only ever emitted
+    # unescaped, so a user key that literally was '<0>' arrives as
+    # '%3C0>' and cannot be mistaken for one), then rebuild sequences and
+    # unescape the remaining dict keys
     out: Dict[str, Any] = {}
     for name, v in flat.items():
-        parts = [_unesc(p) for p in name.split("/")]
+        parts = name.split("/")
         cur = out
         for p in parts[:-1]:
             cur = cur.setdefault(p, {})
         cur[parts[-1]] = v
-    return out
+    return _rebuild(out)
+
+
+def _rebuild(node):
+    """Escaped-key tree → final containers: <i>/<i!t> dicts become
+    lists/tuples, other keys unescape."""
+    if not isinstance(node, dict):
+        return node
+    if node and all(_IDX_RE.match(k) for k in node):
+        items = sorted(((int(_IDX_RE.match(k).group(1)),
+                         _IDX_RE.match(k).group(2), _rebuild(v))
+                        for k, v in node.items()))
+        seq = [v for _, _, v in items]
+        return tuple(seq) if items[0][1] else seq
+    return {_unesc(k): _rebuild(v) for k, v in node.items()}

@@ -41,6 +41,9 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--master", type=str, default=None,
                    help="coordinator host:port (default 127.0.0.1:<free>)")
+    p.add_argument("--ips", type=str, default=None,
+                   help="comma-separated node hostnames, node_rank order "
+                        "(required for --nnodes > 1)")
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--max_restarts", type=int, default=0,
                    help="restarts after worker failure before giving up")
@@ -97,11 +100,22 @@ class _Worker:
 def _build_workers(args, master: str) -> List[_Worker]:
     n_local = args.nproc_per_node
     world = n_local * args.nnodes
-    host = master.split(":")[0]
+    if args.nnodes > 1:
+        if not args.ips:
+            raise SystemExit(
+                "--nnodes > 1 requires --ips host0,host1,... so every "
+                "node's endpoints are addressable")
+        hosts = [h.strip() for h in args.ips.split(",")]
+        if len(hosts) != args.nnodes:
+            raise SystemExit(
+                f"--ips lists {len(hosts)} hosts for --nnodes {args.nnodes}")
+    else:
+        hosts = [master.split(":")[0]]
     endpoints = []
     for node in range(args.nnodes):
         for i in range(n_local):
-            endpoints.append(f"{host}:{args.start_port + node * n_local + i}")
+            endpoints.append(
+                f"{hosts[node]}:{args.start_port + node * n_local + i}")
     workers = []
     for i in range(n_local):
         rank = args.node_rank * n_local + i

@@ -61,33 +61,49 @@ def _aux_load_balance(scores, top1_idx, num_expert):
 
 
 class GShardGate(NaiveGate):
-    """Top-2 gate with load-balance aux loss and capacity awareness
-    (reference: gshard_gate.py:30)."""
+    """Top-2 gate with load-balance aux loss, train/eval capacity factors
+    and GShard's random second-expert routing (reference: gshard_gate.py:30;
+    GShard paper §3.2: the 2nd expert is used with probability proportional
+    to its gate weight — tokens whose 2nd weight is small route top-1 only,
+    which decorrelates overflow)."""
 
     def __init__(self, d_model: int, num_expert: int, top_k: int = 2,
                  capacity=(1.2, 2.4), random_routing: bool = True):
         if top_k != 2:
             raise ValueError("GShardGate works with top_k=2")
         super().__init__(d_model, num_expert, top_k)
-        self.capacity = capacity
+        self.capacity = tuple(capacity)
         self.random_routing = random_routing
+
+    def capacity_factor(self, training: bool) -> float:
+        return self.capacity[0] if training else self.capacity[1]
 
     def forward(self, x):
         scores = self._scores(x)
         val, idx = ops.topk(scores, 2, axis=-1)
         self.set_loss(_aux_load_balance(scores, idx[:, 0], self.num_expert))
+        if self.random_routing and self.training:
+            # keep the 2nd expert with prob min(1, 2*w2): zero its combine
+            # weight otherwise (capacity dispatch then drops the slot)
+            u = ops.rand_like(val[:, 1:2])
+            keep2 = (2.0 * val[:, 1:2] > u).astype(val.dtype)
+            val = ops.concat([val[:, 0:1], val[:, 1:2] * keep2], axis=-1)
         return val, idx
 
 
 class SwitchGate(NaiveGate):
-    """Top-1 switch routing with aux loss (reference: switch_gate.py:30)."""
+    """Top-1 switch routing with aux loss and train/eval capacity factors
+    (reference: switch_gate.py:30)."""
 
     def __init__(self, d_model: int, num_expert: int, top_k: int = 1,
                  capacity=(1.2, 2.4)):
         if top_k != 1:
             raise ValueError("SwitchGate is top-1")
         super().__init__(d_model, num_expert, top_k)
-        self.capacity = capacity
+        self.capacity = tuple(capacity)
+
+    def capacity_factor(self, training: bool) -> float:
+        return self.capacity[0] if training else self.capacity[1]
 
     def forward(self, x):
         scores = self._scores(x)

@@ -99,13 +99,15 @@ class MoELayer(Layer):
     """
 
     def __init__(self, d_model: int, experts, gate=None, moe_group=None,
-                 mp_group=None, capacity_factor: float = 1.2, **kwargs):
+                 mp_group=None, capacity_factor: Optional[float] = None,
+                 **kwargs):
         super().__init__()
         self.d_model = d_model
         self.experts = experts if isinstance(experts, LayerList) \
             else LayerList(list(experts))
         self.num_expert = len(self.experts)
-        self.capacity_factor = float(capacity_factor)
+        self.capacity_factor = (float(capacity_factor)
+                                if capacity_factor is not None else None)
         if gate is None:
             gate = {"type": "gshard", "top_k": 2}
         if isinstance(gate, dict):
@@ -143,7 +145,13 @@ class MoELayer(Layer):
         x2 = x.reshape([-1, H])
         N = x2.shape[0]
         E, K = self.num_expert, self.top_k
-        C = int(np.ceil(K * N / E * self.capacity_factor))
+        # explicit capacity_factor wins; else the gate's train/eval pair
+        # (reference: gates carry (train_cap, eval_cap)); else 1.2
+        cf = self.capacity_factor
+        if cf is None:
+            cf = (self.gate.capacity_factor(self.training)
+                  if hasattr(self.gate, "capacity_factor") else 1.2)
+        C = int(np.ceil(K * N / E * cf))
         val, idx = self.gate(x2)                       # [N,K] f32 / int
         mesh = mesh_mod.get_global_mesh()
         ep = _ep_axes(mesh, E)

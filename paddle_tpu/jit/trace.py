@@ -33,6 +33,7 @@ from ..core import tensor as tensor_mod
 from ..core.tensor import Tensor
 from ..core.autograd import is_grad_enabled
 from ..core.dispatch import apply_op
+from ..core.flags import get_flag
 
 
 def _is_tracer(x):
@@ -392,6 +393,10 @@ class CompiledProgram:
             sd, sk = self._split_state(state_arrays)
             run = self.jitted_donate if self.donate else self.jitted
             out_arrays, write_arrays = run(arg_arrays, sd, sk)
+            if get_flag("check_nan_inf"):
+                from ..core import error_guard
+
+                error_guard.raise_on_error()
             self._writeback(write_arrays)
             out_leaves = [Tensor._wrap(a) for a in out_arrays]
             return _unflatten_io(self.out_tree, out_leaves)
@@ -418,6 +423,10 @@ class CompiledProgram:
         res = apply_op("run_program", primal,
                        list(arg_tensors) + state_wrappers,
                        n_outs=n_out + len(self.write_keys))
+        if get_flag("check_nan_inf"):
+            from ..core import error_guard
+
+            error_guard.raise_on_error()
         if not isinstance(res, tuple):
             res = (res,)
         out_leaves = list(res[:n_out])

@@ -1,0 +1,12 @@
+"""paddle.static parity surface.
+
+The reference's static-graph mode (Program/Executor) is obsolete under
+XLA — `paddle.jit.to_static` IS the static mode (SURVEY.md §7).  This
+namespace keeps the API entry points users reach for: InputSpec, the
+control-flow ops, and no-op mode toggles.
+"""
+from ..jit import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+from .nn import cond, while_loop  # noqa: F401
+
+__all__ = ["InputSpec", "nn", "cond", "while_loop"]

@@ -1,0 +1,171 @@
+"""Control-flow ops for compiled programs (reference:
+python/paddle/fluid/layers/control_flow.py — cond:2334, while_loop:1104 —
+and the dygraph-to-static transformers, dygraph_to_static/
+ifelse_transformer.py, loop_transformer.py).
+
+TPU-native design: the reference rewrites python `if`/`while` into
+ConditionalBlock/While ops via AST transforms.  Here the bridge is explicit
+and functional — `cond` and `while_loop` lower to `lax.cond` /
+`lax.while_loop` when the predicate is traced (inside `to_static`), and
+simply execute eagerly (tape on, fully differentiable) when it is concrete.
+Tensor-dependent python `if` under `to_static` would silently bake one
+branch; these are the supported forms.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop"]
+
+
+def _is_traced(t) -> bool:
+    arr = t._value() if isinstance(t, Tensor) else t
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _leaves_of(fn) -> list:
+    layer = getattr(fn, "__self__", None)
+    from ..nn.layer_base import Layer
+
+    if isinstance(layer, Layer):
+        return list(layer.parameters()) + \
+            [b for _, b in layer.named_buffers()]
+    return []
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, operands: Sequence = (),
+         params: Optional[Sequence] = None, name=None):
+    """Two-way branch on a boolean scalar Tensor.
+
+    Eager (concrete pred): runs the taken branch directly — closures and
+    autograd work as normal.  Traced (inside to_static): lowers to
+    `lax.cond`; both branches must take ``*operands`` and return matching
+    structures, and parameters they touch must be listed in ``params`` (or
+    the fns be bound Layer methods) so gradients flow — same contract as
+    fleet recompute.
+    """
+    if not _is_traced(pred):
+        taken = true_fn if bool(
+            pred.item() if isinstance(pred, Tensor) else pred) else false_fn
+        return taken(*operands)
+
+    externals = list(params) if params is not None else \
+        (_leaves_of(true_fn) + _leaves_of(false_fn))
+    tensor_ops = [o for o in operands if isinstance(o, Tensor)]
+    n_ops = len(tensor_ops)
+    n_outs = _probe_n_outs(true_fn, operands)
+
+    def _branch(fn):
+        def g(arrays):
+            op_arrays = arrays[:n_ops]
+            ext_arrays = arrays[n_ops:]
+            it = iter(op_arrays)
+            full = [Tensor._wrap(next(it)) if isinstance(o, Tensor) else o
+                    for o in operands]
+            saved = [(t, t._data) for t in externals]
+            try:
+                for t, a in zip(externals, ext_arrays):
+                    t._data = a
+                with autograd.no_grad():
+                    out = fn(*full)
+            finally:
+                for t, a in saved:
+                    t._data = a
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            flat = tuple(o._value() if isinstance(o, Tensor)
+                         else jnp.asarray(o) for o in outs)
+            return flat[0] if n_outs == 1 else flat
+        return g
+
+    def primal(pred_arr, *arrays):
+        return jax.lax.cond(jnp.asarray(pred_arr).reshape(()),
+                            _branch(true_fn), _branch(false_fn),
+                            list(arrays))
+
+    return apply_op("cond", primal,
+                    [pred] + tensor_ops + list(externals), n_outs=n_outs)
+
+
+def _probe_n_outs(fn, operands) -> int:
+    """Branch output arity via eval_shape (no FLOPs, no tape)."""
+    import jax
+
+    def f(*arrs):
+        it = iter(arrs)
+        full = [Tensor._wrap(next(it)) if isinstance(o, Tensor) else o
+                for o in operands]
+        with autograd.no_grad():
+            out = fn(*full)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(o._value() if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in outs)
+
+    shapes = jax.eval_shape(
+        f, *[o._value() for o in operands if isinstance(o, Tensor)])
+    return len(shapes)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None):
+    """``while cond_fn(*vars): vars = body_fn(*vars)``.
+
+    Eager: a python loop — differentiable, any closure.  Traced: lowers to
+    `lax.while_loop` (forward-only, like XLA's While; the reference's
+    backward-of-while is likewise restricted) over the Tensor loop vars;
+    body/cond must be pure functions of them.
+    """
+    loop_vars = list(loop_vars)
+    traced = any(_is_traced(v) for v in loop_vars if isinstance(v, Tensor))
+    if not traced:
+        out = loop_vars
+        while bool(_as_scalar(cond_fn(*out))):
+            res = body_fn(*out)
+            out = list(res) if isinstance(res, (tuple, list)) else [res]
+        return out
+
+    idx = [i for i, v in enumerate(loop_vars) if isinstance(v, Tensor)]
+
+    def _call(fn, arrays, scalar=False):
+        full = list(loop_vars)
+        for j, i in enumerate(idx):
+            full[i] = Tensor._wrap(arrays[j])
+        with autograd.no_grad():
+            out = fn(*full)
+        if scalar:
+            return jnp.asarray(
+                out._value() if isinstance(out, Tensor) else out).reshape(())
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        res = list(arrays)
+        k = 0
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor):
+                res[k] = o._value()
+                k += 1
+        return tuple(res)
+
+    def primal(*arrays):
+        return jax.lax.while_loop(
+            lambda vs: _call(cond_fn, vs, scalar=True),
+            lambda vs: _call(body_fn, vs),
+            tuple(arrays))
+
+    tensors = [loop_vars[i] for i in idx]
+    outs = apply_op("while_loop", primal, tensors, n_outs=len(tensors))
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    result = list(loop_vars)
+    for j, i in enumerate(idx):
+        result[i] = outs[j]
+    return result
+
+
+def _as_scalar(v):
+    if isinstance(v, Tensor):
+        return v.item()
+    return v

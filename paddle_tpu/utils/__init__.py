@@ -1,0 +1,19 @@
+"""paddle.utils parity namespace."""
+from . import cpp_extension  # noqa: F401
+from ..core.custom_kernel import (  # noqa: F401
+    register_kernel, register_op, unregister_kernel,
+)
+
+__all__ = ["cpp_extension", "register_op", "register_kernel",
+           "unregister_kernel"]
+
+
+def try_import(module_name: str):
+    """Reference paddle.utils.try_import."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"Failed to import {module_name}: {e}") from e

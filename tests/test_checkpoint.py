@@ -86,6 +86,55 @@ class TestSaveLoadRoundtrip:
             np.asarray(out["w"].astype("float32").numpy()),
             np.asarray(w.astype("float32").numpy()))
 
+    def test_list_tuple_and_scheduler_state_roundtrip(self, tmp_path):
+        """Lists/tuples (e.g. PiecewiseDecay boundaries in LRScheduler
+        state) must round-trip with their container type intact."""
+        mesh_mod.set_global_mesh(_mesh(dp=8, mp=1))
+        paddle.seed(0)
+        model = nn.Linear(4, 4)
+        sched = paddle.optimizer.lr.PiecewiseDecay(
+            boundaries=[100, 200], values=[0.1, 0.05, 0.01])
+        opt = paddle.optimizer.AdamW(learning_rate=sched,
+                                     parameters=model.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sched.step()
+        path = str(tmp_path / "ck")
+        ckpt.save_state_dict({"opt": opt.state_dict(),
+                              "misc": {"shape": (3, 4), "tags": ["a", "b"]}},
+                             path)
+        loaded = ckpt.load_state_dict(path)
+        assert loaded["misc"]["shape"] == (3, 4)
+        assert loaded["misc"]["tags"] == ["a", "b"]
+        opt2 = paddle.optimizer.AdamW(
+            learning_rate=paddle.optimizer.lr.PiecewiseDecay(
+                boundaries=[1, 2], values=[1.0, 1.0, 1.0]),
+            parameters=model.parameters())
+        opt2.set_state_dict(loaded["opt"])
+        assert opt2.get_lr() == opt.get_lr()
+
+    def test_overwrite_keeps_old_checkpoint_valid_until_commit(self,
+                                                               tmp_path):
+        """Saving over an existing directory uses a new file generation —
+        the first save's files are untouched until the new index commits."""
+        mesh_mod.set_global_mesh(_mesh(dp=8, mp=1))
+        path = str(tmp_path / "ck")
+        w1 = paddle.to_tensor(np.full((4,), 1.0, np.float32))
+        ckpt.save_state_dict({"w": w1}, path)
+        files_gen0 = set(os.listdir(path))
+        w2 = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+        ckpt.save_state_dict({"w": w2}, path)
+        out = ckpt.load_state_dict(path, return_numpy=True)
+        np.testing.assert_array_equal(out["w"], 2.0)
+        # old-generation shard files were GC'd after the commit
+        leftover = [f for f in files_gen0
+                    if f.endswith(".npy") and
+                    f in os.listdir(path)]
+        assert leftover == []
+
     def test_async_save(self, tmp_path):
         mesh_mod.set_global_mesh(_mesh(dp=8, mp=1))
         w = paddle.to_tensor(np.ones((16, 4), np.float32))

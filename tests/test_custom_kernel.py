@@ -1,0 +1,113 @@
+"""Custom op / custom kernel plugin point (reference:
+phi/core/custom_kernel.h:49, python/paddle/utils/cpp_extension)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import register_kernel, register_op, unregister_kernel
+
+
+class TestRegisterOp:
+    def test_new_op_with_autograd(self):
+        import jax.numpy as jnp
+
+        my_op = register_op("test_cube", lambda x: x ** 3)
+        x = paddle.to_tensor(np.array([1.0, 2.0], dtype=np.float32))
+        x.stop_gradient = False
+        y = my_op(x)
+        np.testing.assert_allclose(np.asarray(y.numpy()), [1.0, 8.0])
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad), [3.0, 12.0])
+        unregister_kernel("test_cube")
+
+    def test_custom_vjp(self):
+        import jax.numpy as jnp
+
+        # op with a deliberately nonstandard gradient (grad = 10 everywhere)
+        my_op = register_op(
+            "test_customgrad", lambda x: x * 2.0,
+            vjp=lambda res, g: (jnp.full_like(res[0], 10.0) * 0 + 10.0 * g / g,))
+        x = paddle.to_tensor(np.array([3.0], dtype=np.float32))
+        x.stop_gradient = False
+        my_op(x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad), [10.0])
+        unregister_kernel("test_customgrad")
+
+    def test_kernel_override_of_builtin(self):
+        """custom_kernel.h semantics: replace an existing op's kernel."""
+        try:
+            register_kernel("relu", lambda x: x * 0.0 + 42.0)
+            x = paddle.to_tensor(np.array([-1.0, 5.0], dtype=np.float32))
+            out = paddle.nn.functional.relu(x)
+            np.testing.assert_allclose(np.asarray(out.numpy()), 42.0)
+        finally:
+            unregister_kernel("relu")
+        out = paddle.nn.functional.relu(
+            paddle.to_tensor(np.array([-1.0, 5.0], dtype=np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [0.0, 5.0])
+
+    def test_backend_scoped_override_ignored_on_other_backend(self):
+        import jax
+
+        other = "tpu" if jax.default_backend() != "tpu" else "gpu"
+        try:
+            register_kernel("sigmoid", lambda x: x * 0.0, backend=other)
+            x = paddle.to_tensor(np.array([0.0], dtype=np.float32))
+            np.testing.assert_allclose(
+                np.asarray(paddle.sigmoid(x).numpy()), [0.5])
+        finally:
+            unregister_kernel("sigmoid", backend=other)
+
+
+CPP_SRC = r"""
+#include <cstdint>
+#include <cmath>
+
+extern "C" void twice_plus_one(const float* x, float* y, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) y[i] = 2.0f * x[i] + 1.0f;
+}
+
+extern "C" void softsign_ref(const float* x, float* y, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) y[i] = x[i] / (1.0f + std::fabs(x[i]));
+}
+"""
+
+
+class TestCppExtension:
+    @pytest.fixture(scope="class")
+    def ext(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("ext")
+        src = d / "my_ops.cc"
+        src.write_text(CPP_SRC)
+        from paddle_tpu.utils import cpp_extension
+
+        mod = cpp_extension.load(
+            "my_ops", [str(src)],
+            functions={"twice_plus_one": {}, "softsign_ref": {}},
+            build_directory=str(d))
+        yield mod
+        unregister_kernel("my_ops.twice_plus_one")
+        unregister_kernel("my_ops.softsign_ref")
+
+    def test_eager_call(self, ext):
+        x = paddle.to_tensor(np.array([1.0, -2.0], dtype=np.float32))
+        out = ext.twice_plus_one(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [3.0, -3.0])
+
+    def test_matches_python_op(self, ext):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(8).astype(np.float32))
+        ref = paddle.nn.functional.softsign(x)
+        got = ext.softsign_ref(x)
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(ref.numpy()), atol=1e-6)
+
+    def test_under_jit(self, ext):
+        @paddle.jit.to_static
+        def f(x):
+            return ext.twice_plus_one(x) * 2.0
+
+        x = paddle.to_tensor(np.array([1.0], dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(f(x).numpy()), [6.0])

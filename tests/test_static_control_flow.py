@@ -1,0 +1,92 @@
+"""paddle.static cond / while_loop (reference: control_flow.py cond:2334,
+while_loop:1104; dy2static ifelse/loop transformers) — eager AND compiled
+(lax.cond / lax.while_loop) behavior."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import cond, while_loop
+
+
+class TestCondEager:
+    def test_takes_branch_and_grads(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], dtype=np.float32))
+        x.stop_gradient = False
+        out = cond(paddle.to_tensor(True), lambda a: (a * 2).sum(),
+                   lambda a: (a * 3).sum(), operands=(x,))
+        out.backward()
+        np.testing.assert_allclose(np.asarray(x.grad), 2.0)
+
+    def test_false_branch(self):
+        x = paddle.to_tensor(np.array([1.0], dtype=np.float32))
+        out = cond(paddle.to_tensor(False), lambda a: a * 2, lambda a: a * 3,
+                   operands=(x,))
+        assert float(out) == 3.0
+
+
+class TestCondCompiled:
+    def test_data_dependent_branch_under_jit(self):
+        """The case trace-based to_static CANNOT express with python if:
+        a branch chosen by a traced value, compiled once, correct for
+        both inputs."""
+
+        @paddle.jit.to_static
+        def f(x):
+            return cond(x.sum() > 0,
+                        lambda a: a * 2.0,
+                        lambda a: a - 1.0, operands=(x,))
+
+        pos = paddle.to_tensor(np.array([1.0, 2.0], dtype=np.float32))
+        neg = paddle.to_tensor(np.array([-1.0, -2.0], dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(f(pos).numpy()), [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(f(neg).numpy()), [-2.0, -3.0])
+
+    def test_grads_through_compiled_cond(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(3, 3)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+
+        @paddle.jit.to_static
+        def step(x, y):
+            h = cond(x.sum() > 0, lin.forward, lambda a: a * 0.0,
+                     operands=(x,))
+            loss = ((h - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(np.abs(rs.randn(4, 3)).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(4, 3).astype(np.float32))
+        losses = [float(step(x, y)) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+
+class TestWhileLoop:
+    def test_eager(self):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i, s = while_loop(lambda i, s: i < 5,
+                          lambda i, s: (i + 1, s + 2.0), [i, s])
+        assert int(i) == 5 and float(s) == 10.0
+
+    def test_compiled(self):
+        @paddle.jit.to_static
+        def f(n, x):
+            def body(i, acc):
+                return i + 1, acc * 2.0
+
+            i, acc = while_loop(lambda i, acc: i < n, body,
+                                [paddle.to_tensor(np.int32(0)) * 0 + 0, x])
+            return acc
+
+        x = paddle.to_tensor(np.array([1.0], dtype=np.float32))
+        out = f(paddle.to_tensor(np.int32(4)), x)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [16.0])
+        # compiled once, data-dependent trip count
+        out2 = f(paddle.to_tensor(np.int32(6)), x)
+        np.testing.assert_allclose(np.asarray(out2.numpy()), [64.0])
