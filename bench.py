@@ -48,9 +48,16 @@ def main():
     # fused_linear_cross_entropy (vocab-blockwise streamed CE): no [B,S,V]
     # logits tensor is ever materialized, which un-caps the batch that
     # previously OOMed at 16 on the f32 logits temp.
-    cfg = gpt2_345m(recompute=False, hidden_dropout_prob=0.0,
-                    attention_probs_dropout_prob=0.0)
-    seq = 1024
+    if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
+        # correctness smoke of the exact bench path on tiny shapes (CPU ok)
+        from paddle_tpu.models import gpt_tiny
+
+        cfg = gpt_tiny()
+        seq = 32
+    else:
+        cfg = gpt2_345m(recompute=False, hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        seq = 1024
     batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "16")) \
         * len(jax.devices())
     model = fleet.distributed_model(GPTForCausalLM(cfg))

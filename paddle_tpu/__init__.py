@@ -58,7 +58,7 @@ from .ops.logic import is_tensor
 # (round-2 review: the try/except-ImportError pattern hid breakage).
 from . import (  # noqa: F401
     nn, optimizer, amp, io, jit, vision, metric, distributed, autograd,
-    framework, profiler, incubate, hapi, static,
+    framework, profiler, incubate, hapi, static, text, utils, inference,
 )
 
 from .framework.io import save, load  # noqa: F401

@@ -80,6 +80,7 @@ class PipelineLayer(Layer):
         super().__init__()
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
         if topology is not None:
             self._num_stages = topology.get_dim("pipe")
         else:

@@ -69,9 +69,17 @@ class PipelineParallel(Layer):
         # pinned by tests/test_pipeline.py::TestJaxSwitchVmaAD).  Until
         # that is fixed upstream, non-uniform stacks run sequentially.
         self._schedule = "sequential"
+        self.num_virtual = max(getattr(layers, "_num_virtual", 1), 1)
         if (self.num_stages > 1 and run_len >= self.num_stages
                 and run_len % self.num_stages == 0):
             self._schedule = "uniform"
+            # interleaved schedule needs layers to divide P*v and
+            # microbatches to divide P; degrade to v=1 otherwise
+            n_micro = max(self.accumulate_steps, 1)
+            if self.num_virtual > 1 and (
+                    run_len % (self.num_stages * self.num_virtual) != 0
+                    or n_micro % self.num_stages != 0):
+                self.num_virtual = 1
             self._prologue = body[:start]
             self._body = body[start:end]
             self._epilogue = body[end:]
@@ -94,7 +102,8 @@ class PipelineParallel(Layer):
             x = l(x)
         n_micro = max(self.accumulate_steps, 1)
         x = pipeline_apply(self._template, self._body_leaves, x,
-                           self.num_stages, n_micro, self._hcg.mesh)
+                           self.num_stages, n_micro, self._hcg.mesh,
+                           n_virtual=self.num_virtual)
         for l in self._epilogue:
             x = l(x)
         return x

@@ -183,19 +183,103 @@ def _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh, key_arr,
     return inner_f(key_arr, xs, *extra_flat)
 
 
+def _scan_pipeline_interleaved(chunk_fn, xs, n_stages, n_micro, n_virtual,
+                               mesh, key_arr, extra_flat, extra_specs):
+    """Interleaved (virtual-stage) schedule — one XLA scan.
+
+    Reference contract: PipelineLayer(num_virtual_pipeline_stages=v) +
+    the Megatron interleaved 1F1B (the reference only ships plain 1F1B;
+    interleaving is a beyond-reference bubble reduction).
+
+    Construction: the layer stack is cut into v·P chunks; device i owns
+    chunks {i, P+i, …, (v−1)P+i}.  Microbatches are injected in bursts of
+    P (burst b starts at tick b·v·P); every tick each device runs ONE
+    chunk and the activation ppermutes one hop.  At tick t device i
+    solves::
+
+        r = (t − i) mod P          # burst offset of its active microbatch
+        j = (t − r) mod v·P        # the global chunk it must run
+        b = (t − r) // (v·P)       # which burst
+        m = b·P + r                # microbatch id (valid iff 0 ≤ b < M/P)
+        c = j // P                 # local chunk index (j ≡ i (mod P))
+
+    Total ticks v·M + P − 1, so the bubble is (P−1)/(v·M+P−1) versus
+    1F1B's (P−1)/(M+P−1), at the cost of (v−1) extra ppermute hops per
+    microbatch — the interleaving trade.  Memory matches the uniform
+    schedule: the tick body is rematerialized, so the backward holds one
+    per-tick chunk input.
+    """
+    vP = n_virtual * n_stages
+    n_ticks = n_virtual * n_micro + n_stages - 1
+
+    def inner(key_l, xs_full, *extras):
+        stage = jax.lax.axis_index("pipe")
+        xs_full = _enter_pipe(xs_full)
+        state0 = _pipe_varying(jnp.zeros(xs_full.shape[1:], xs_full.dtype))
+
+        body = jax.checkpoint(
+            lambda x_in, c, t: chunk_fn(stage, c, t, key_l, x_in, extras),
+            prevent_cse=False)
+
+        def tick(carry, t):
+            state = carry
+            r = (t - stage) % n_stages
+            j = (t - r) % vP
+            b = (t - r) // vP
+            m = b * n_stages + r
+            valid = (b >= 0) & (b < n_micro // n_stages)
+            c = j // n_stages
+            inject = (stage == 0) & (j == 0) & valid
+            m_safe = jnp.clip(m, 0, n_micro - 1)
+            fresh = jax.lax.dynamic_index_in_dim(
+                xs_full, m_safe, axis=0, keepdims=False)
+            x_in = jnp.where(inject, fresh, state)
+            y = body(x_in, c, t)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            shifted = jax.lax.ppermute(y, "pipe", perm)
+            emit = (stage == n_stages - 1) & (j == vP - 1) & valid
+            out_t = jnp.where(emit, y, jnp.zeros_like(y))
+            return shifted, out_t
+
+        ys = jax.lax.scan(tick, state0, jnp.arange(n_ticks,
+                                                   dtype=jnp.int32))[1]
+        # microbatch m finishes at tick (m//P)·v·P + (m%P) + v·P − 1
+        mm = jnp.arange(n_micro)
+        finish = (mm // n_stages) * vP + (mm % n_stages) + vP - 1
+        ys = jnp.take(ys, finish, axis=0)
+        return _psum_pipe_f32(ys)
+
+    in_specs = (P(), P()) + tuple(extra_specs)
+    inner_f = shard_map(
+        inner, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        axis_names={"pipe"})
+    return inner_f(key_arr, xs, *extra_flat)
+
+
 def pipeline_apply(template: Layer, per_layer_leaves: Sequence[Sequence[Tensor]],
-                   x: Tensor, n_stages: int, n_micro: int, mesh) -> Tensor:
+                   x: Tensor, n_stages: int, n_micro: int, mesh,
+                   n_virtual: int = 1) -> Tensor:
     """Run a uniform layer stack over the pipe axis.
 
     per_layer_leaves: [n_layers][n_leaf] framework Tensors (the real
     Parameters — their .grad receives the pipeline's backward).
     x: [B, ...] activations entering the stack.  B must divide n_micro.
+    n_virtual > 1 selects the interleaved (virtual-stage) schedule:
+    n_stages*n_virtual must divide n_layers, and n_stages must divide
+    n_micro.
     """
     n_layers = len(per_layer_leaves)
     n_leaf = len(per_layer_leaves[0])
-    if n_layers % n_stages:
-        raise ValueError(f"{n_layers} layers do not divide {n_stages} stages")
-    k_per_stage = n_layers // n_stages
+    n_chunks = n_stages * max(n_virtual, 1)
+    if n_layers % n_chunks:
+        raise ValueError(
+            f"{n_layers} layers do not divide {n_stages} stages x "
+            f"{n_virtual} virtual chunks")
+    if n_virtual > 1 and n_micro % n_stages:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({n_micro}) divisible "
+            f"by stages ({n_stages})")
+    k_chunk = n_layers // n_chunks
     flat_params: List[Tensor] = [t for layer in per_layer_leaves for t in layer]
 
     gen_state = rng_mod.default_generator()._state
@@ -207,24 +291,60 @@ def pipeline_apply(template: Layer, per_layer_leaves: Sequence[Sequence[Tensor]]
             raise ValueError(f"batch {B} does not divide {n_micro} microbatches")
         mb = B // n_micro
         xs = x_arr.reshape((n_micro, mb) + x_arr.shape[1:])
-        # stack layer leaves → [n_stages, k_per_stage, ...] sharded on pipe
+
+        if n_virtual <= 1:
+            # stack leaves → [n_stages, k_chunk, ...] sharded on pipe
+            stacked = []
+            for j in range(n_leaf):
+                s = jnp.stack([leaf_arrays[i * n_leaf + j]
+                               for i in range(n_layers)], axis=0)
+                s = s.reshape((n_stages, k_chunk) + s.shape[1:])
+                stacked.append(s)
+
+            def stage_fn(stage, t, key_l, x_in, stacked_local):
+                y = x_in
+                saved_state = gen_state._data
+                try:
+                    for k in range(k_chunk):
+                        arrs = [lv[0, k] for lv in stacked_local]
+                        # per-(tick, local-layer) RNG stream for dropout
+                        kk = jax.random.fold_in(
+                            jax.random.wrap_key_data(key_l),
+                            t * n_layers + stage * k_chunk + k)
+                        gen_state._data = jax.random.key_data(kk)
+                        y = _template_apply(template, arrs, y)
+                finally:
+                    gen_state._data = saved_state
+                return y
+
+            extra_specs = tuple(P("pipe") for _ in range(n_leaf))
+            ys = _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh,
+                                key_arr, tuple(stacked), extra_specs)
+            return ys.reshape((B,) + ys.shape[2:])
+
+        # interleaved: chunk j = c*P + i lives at stacked[i, c]
         stacked = []
         for j in range(n_leaf):
             s = jnp.stack([leaf_arrays[i * n_leaf + j]
                            for i in range(n_layers)], axis=0)
-            s = s.reshape((n_stages, k_per_stage) + s.shape[1:])
+            s = s.reshape((n_virtual, n_stages, k_chunk) + s.shape[1:])
+            s = jnp.swapaxes(s, 0, 1)      # [P, v, k_chunk, ...]
             stacked.append(s)
 
-        def stage_fn(stage, t, key_l, x_in, stacked_local):
+        def chunk_fn(stage, c, t, key_l, x_in, stacked_local):
             y = x_in
             saved_state = gen_state._data
             try:
-                for k in range(k_per_stage):
-                    arrs = [lv[0, k] for lv in stacked_local]
-                    # per-(tick, local-layer) RNG stream for dropout
+                for k in range(k_chunk):
+                    # local leaves [1, v, k_chunk, ...] — dynamic chunk
+                    # select (exact AD, unlike lax.switch; see module note)
+                    arrs = [jax.lax.dynamic_index_in_dim(
+                        lv[0], c, axis=0, keepdims=False)[k]
+                        for lv in stacked_local]
+                    layer_id = (c * n_stages + stage) * k_chunk + k
                     kk = jax.random.fold_in(
                         jax.random.wrap_key_data(key_l),
-                        t * n_layers + stage * k_per_stage + k)
+                        t * n_layers + layer_id)
                     gen_state._data = jax.random.key_data(kk)
                     y = _template_apply(template, arrs, y)
             finally:
@@ -232,8 +352,9 @@ def pipeline_apply(template: Layer, per_layer_leaves: Sequence[Sequence[Tensor]]
             return y
 
         extra_specs = tuple(P("pipe") for _ in range(n_leaf))
-        ys = _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh,
-                            key_arr, tuple(stacked), extra_specs)
+        ys = _scan_pipeline_interleaved(
+            chunk_fn, xs, n_stages, n_micro, n_virtual, mesh, key_arr,
+            tuple(stacked), extra_specs)
         return ys.reshape((B,) + ys.shape[2:])
 
     return apply_op("pipeline_scan_remat", primal,

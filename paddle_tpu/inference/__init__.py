@@ -1,0 +1,193 @@
+"""paddle.inference — the deployment/serving API.
+
+Reference parity: paddle/fluid/inference (AnalysisConfig/AnalysisPredictor,
+paddle_inference_api.h) surfaced as python paddle.inference Config /
+create_predictor / Predictor handles.
+
+TPU-native design: the reference's analysis+IR-optimization pipeline
+(71.8k LoC of pass management) is XLA's job — a jit.save artifact is an
+already-optimized serialized StableHLO program.  What remains is the
+SERVING surface: model loading, named input/output handles, batched run.
+The Config knobs that configure CUDA/MKLDNN/TensorRT are accepted for
+source compatibility and recorded; device selection maps onto the jax
+backend.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor as _FrameworkTensor
+from .. import jit as jit_mod
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    TPU = 3
+
+
+class Config:
+    """Reference: paddle.inference.Config (analysis_config.cc)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accepts the combined-path form Config("model") where
+        # model.pdmodel/model.pdiparams exist, or explicit files
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            self._path = prog_file[:-len(".pdmodel")]
+        else:
+            self._path = prog_file
+        self._params_file = params_file
+        self._use_accelerator = True
+        self._memory_pool_mb = 0
+        self._ir_optim = True
+        self._precision = PrecisionType.Float32
+        self._extra: Dict[str, object] = {}
+
+    # -- device ---------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0,
+                       precision=PrecisionType.Float32):
+        self._use_accelerator = True
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._precision = precision
+
+    enable_use_tpu = enable_use_gpu
+
+    def disable_gpu(self):
+        self._use_accelerator = False
+
+    def use_gpu(self) -> bool:
+        return self._use_accelerator
+
+    # -- optimization knobs (XLA owns these; recorded for API parity) ---
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = x
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, x: bool = True):
+        self._extra["memory_optim"] = x
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._extra["cpu_threads"] = n
+
+    def enable_mkldnn(self):
+        self._extra["mkldnn"] = True
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._extra["tensorrt"] = True
+
+    def model_dir(self) -> Optional[str]:
+        return self._path
+
+    def prog_file(self) -> Optional[str]:
+        return (self._path + ".pdmodel") if self._path else None
+
+    def params_file(self) -> Optional[str]:
+        return self._params_file or (
+            (self._path + ".pdiparams") if self._path else None)
+
+    def summary(self) -> str:
+        return (f"Config(path={self._path}, accelerator="
+                f"{self._use_accelerator}, ir_optim={self._ir_optim})")
+
+
+class Tensor:
+    """Named input/output handle (reference: paddle_infer.Tensor /
+    ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._data: Optional[np.ndarray] = None
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = np.asarray(self._data).reshape(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._data = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def shape(self):
+        return list(np.asarray(self._data).shape) if self._data is not None \
+            else []
+
+
+class Predictor:
+    """Reference: AnalysisPredictor via create_predictor."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        if not config.model_dir():
+            raise ValueError("Config needs a model path (jit.save artifact)")
+        self._layer = jit_mod.load(config.model_dir())
+        # the export's input tree is ((state_leaves, input_leaves), kwargs);
+        # the model-input count is the second child's leaf count
+        n_in = 1
+        try:
+            exported = self._layer._exported
+            args_td = exported.in_tree.children()[0]
+            n_in = args_td.children()[1].num_leaves
+        except Exception:
+            pass
+        self._input_names = [f"x{i}" for i in range(max(n_in, 1))]
+        self._inputs: Dict[str, Tensor] = {
+            n: Tensor(n) for n in self._input_names}
+        self._outputs: List[Tensor] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Reference run(): either pass arrays directly, or use the
+        copy_from_cpu handles then run()."""
+        if inputs is not None:
+            arrs = [np.asarray(a) for a in inputs]
+        else:
+            arrs = [self._inputs[n].copy_to_cpu()
+                    for n in self._input_names]
+        out = self._layer(*arrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        res = []
+        for i, o in enumerate(outs):
+            arr = np.asarray(o.numpy()) if isinstance(
+                o, _FrameworkTensor) else np.asarray(o)
+            t = Tensor(f"out{i}")
+            t.copy_from_cpu(arr)
+            self._outputs.append(t)
+            res.append(arr)
+        return res if inputs is not None else True
+
+    def get_output_names(self) -> List[str]:
+        return [t.name for t in self._outputs]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

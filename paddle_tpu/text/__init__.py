@@ -1,0 +1,6 @@
+"""paddle.text parity (reference: python/paddle/text)."""
+from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "viterbi_decode",
+           "ViterbiDecoder"]

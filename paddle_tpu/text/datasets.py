@@ -1,0 +1,185 @@
+"""paddle.text datasets (reference: python/paddle/text/datasets/*.py).
+
+Same Dataset API and file formats as the reference; this environment has no
+network egress, so ``download=True`` with no local file raises with
+instructions instead of fetching — pass ``data_file`` pointing at a local
+copy (the reference supports the same override).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+
+
+def _require(data_file: Optional[str], name: str, url_hint: str) -> str:
+    if data_file and os.path.exists(data_file):
+        return data_file
+    raise RuntimeError(
+        f"{name}: no local data_file and downloads are unavailable in this "
+        f"environment. Fetch {url_hint} manually and pass data_file=...")
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference: uci_housing.py — 13 features,
+    80/20 train/test split, feature-wise max-min normalization)."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = True):
+        assert mode in ("train", "test")
+        path = _require(data_file, "UCIHousing",
+                        "https://archive.ics.uci.edu/ml/machine-learning-"
+                        "databases/housing/housing.data")
+        raw = np.loadtxt(path).astype(np.float32)
+        raw = raw.reshape(-1, self.FEATURE_NUM)
+        maxi, mini = raw.max(axis=0), raw.min(axis=0)
+        avg = raw.mean(axis=0)
+        span = np.where(maxi - mini == 0, 1.0, maxi - mini)
+        feats = (raw - avg) / span
+        raw = np.concatenate(
+            [feats[:, :-1], raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: imdb.py — aclImdb tgz, word-frequency
+    vocabulary with a cutoff of 150, <unk> id = len(vocab))."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, download: bool = True):
+        assert mode in ("train", "test")
+        path = _require(data_file, "Imdb",
+                        "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz")
+        pat = re.compile(rf"aclImdb/{mode}/pos/.*\.txt$")
+        neg_pat = re.compile(rf"aclImdb/{mode}/neg/.*\.txt$")
+        self.word_idx = self._build_vocab(path, cutoff)
+        self.docs: List[np.ndarray] = []
+        self.labels: List[int] = []
+        for docs, label in ((self._tokenize(path, pat), 0),
+                            (self._tokenize(path, neg_pat), 1)):
+            unk = len(self.word_idx)
+            for d in docs:
+                self.docs.append(np.array(
+                    [self.word_idx.get(w, unk) for w in d], dtype=np.int64))
+                self.labels.append(label)
+
+    @staticmethod
+    def _tokenize(path, pattern) -> List[List[str]]:
+        out = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if pattern.match(m.name or ""):
+                    data = tf.extractfile(m).read().decode("latin-1")
+                    out.append(data.lower().replace("<br />", " ").split())
+        return out
+
+    def _build_vocab(self, path, cutoff):
+        from collections import Counter
+
+        freq = Counter()
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name or ""):
+                    words = tf.extractfile(m).read().decode("latin-1") \
+                        .lower().replace("<br />", " ").split()
+                    freq.update(words)
+        freq.pop("<unk>", None)
+        words = [w for w, c in freq.items() if c > cutoff]
+        words.sort(key=lambda w: (-freq[w], w))
+        return {w: i for i, w in enumerate(words)}
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        # reference imdb.py:142 — label as a shape-(1,) array
+        return self.docs[i], np.array([self.labels[i]], dtype=np.int64)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram LM dataset (reference: imikolov.py — train/valid from the
+    simple-examples tgz; n-gram or sequence data_type)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = -1, mode: str = "train",
+                 min_word_freq: int = 50, download: bool = True):
+        assert mode in ("train", "test")
+        assert data_type in ("NGRAM", "SEQ")
+        path = _require(data_file, "Imikolov",
+                        "https://dataset.bj.bcebos.com/imikolov%2F"
+                        "simple-examples.tar.gz")
+        self.window_size = window_size
+        self.data_type = data_type
+        # reference imikolov.py:143 — mode names the file directly
+        # (ptb.test.txt for test; ptb.valid.txt only feeds the vocab)
+        fname = f"./simple-examples/data/ptb.{mode}.txt"
+        self.word_idx = self._build_vocab(path, min_word_freq)
+        self.data = []
+        with tarfile.open(path) as tf:
+            f = tf.extractfile(fname)
+            lines = f.read().decode("utf-8").splitlines()
+        unk = self.word_idx["<unk>"]
+        for ln in lines:
+            words = ln.strip().split()
+            ids = [self.word_idx["<s>"]] + \
+                [self.word_idx.get(w, unk) for w in words] + \
+                [self.word_idx["<e>"]]
+            if data_type == "NGRAM":
+                if window_size <= 0:
+                    raise ValueError("NGRAM needs window_size > 0")
+                # reference imikolov.py:153 — window_size ids per item
+                for i in range(window_size, len(ids) + 1):
+                    self.data.append(
+                        np.array(ids[i - window_size:i], dtype=np.int64))
+            else:
+                src, tgt = ids[:-1], ids[1:]
+                # reference imikolov.py:160 — drop over-long sequences
+                if window_size > 0 and len(src) > window_size:
+                    continue
+                self.data.append((np.array(src, dtype=np.int64),
+                                  np.array(tgt, dtype=np.int64)))
+
+    def _build_vocab(self, path, min_word_freq):
+        """Reference _build_work_dict: counts over train+valid with one
+        <s>/<e> per line (so the markers get frequency-ranked ids), strict
+        cutoff, <unk> appended last."""
+        from collections import Counter
+
+        freq = Counter()
+        with tarfile.open(path) as tf:
+            for split in ("train", "valid"):
+                f = tf.extractfile(f"./simple-examples/data/ptb.{split}.txt")
+                for ln in f.read().decode("utf-8").splitlines():
+                    freq.update(ln.strip().split())
+                    freq["<s>"] += 1
+                    freq["<e>"] += 1
+        freq.pop("<unk>", None)
+        words = [w for w, c in freq.items() if c > min_word_freq]
+        words.sort(key=lambda w: (-freq[w], w))
+        idx = {w: i for i, w in enumerate(words)}
+        idx["<unk>"] = len(idx)
+        return idx
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]

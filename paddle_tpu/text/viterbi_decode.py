@@ -1,0 +1,94 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py:24 →
+phi viterbi_decode kernel).
+
+TPU-native: the per-timestep max-product recursion is a `lax.scan` over the
+sequence (compiler-friendly static shapes); variable lengths are handled by
+freezing the alpha carry and using identity backpointers past each
+sequence's end, so one compiled program serves every length in the batch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..ops._helpers import nondiff
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(pot, trans, lengths, include_bos_eos_tag):
+    B, S, N = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    start_idx, stop_idx = N - 1, N - 2
+    alpha = pot[:, 0].astype(jnp.float32)
+    if include_bos_eos_tag:
+        alpha = alpha + trans[start_idx][None, :].astype(jnp.float32)
+
+    transf = trans.astype(jnp.float32)
+
+    def step(alpha, t):
+        # [B, prev, next]
+        scores = alpha[:, :, None] + transf[None]
+        best_prev = jnp.argmax(scores, axis=1)                   # [B, N]
+        best_score = jnp.max(scores, axis=1) + pot[:, t].astype(jnp.float32)
+        active = (t < lengths)[:, None]
+        new_alpha = jnp.where(active, best_score, alpha)
+        bp = jnp.where(active, best_prev,
+                       jnp.arange(N, dtype=best_prev.dtype)[None, :])
+        return new_alpha, bp
+
+    alpha, bps = jax.lax.scan(step, alpha, jnp.arange(1, S))     # bps [S-1,B,N]
+    if include_bos_eos_tag:
+        alpha = alpha + transf[:, stop_idx][None, :]
+    scores = jnp.max(alpha, axis=1).astype(pot.dtype)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)       # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan emits tag_t at slot t-1 (bps[k] holds t=k+1 pointers)
+    # and its final carry is tag_0
+    tag0, tags_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([tag0[:, None],
+                            jnp.swapaxes(tags_rev, 0, 1)], axis=1)  # [B, S]
+    mask = jnp.arange(S)[None, :] < lengths[:, None]
+    # int32, not the reference's int64: x64 is disabled framework-wide
+    # (ids never exceed num_tags) and an int64 cast would only warn+truncate
+    return scores, jnp.where(mask, path, 0).astype(jnp.int32)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Highest-scoring tag sequence under emissions + transition matrix.
+
+    Returns (scores [B], paths [B, max_len]); with concrete lengths the
+    path is truncated to the batch max length like the reference kernel.
+    """
+    scores, path = nondiff(
+        "viterbi_decode",
+        lambda p, t, l: _viterbi(p, t, l, include_bos_eos_tag),
+        [potentials, transition_params, lengths], n_outs=2)
+    larr = lengths._value() if isinstance(lengths, Tensor) else lengths
+    if not isinstance(larr, jax.core.Tracer):
+        max_len = int(np.max(np.asarray(larr))) if np.size(
+            np.asarray(larr)) else 0
+        path = path[:, :max_len]
+    return scores, path
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference: viterbi_decode.py:92)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

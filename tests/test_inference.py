@@ -1,0 +1,56 @@
+"""paddle.inference serving surface (reference: fluid/inference
+AnalysisConfig/AnalysisPredictor via python paddle.inference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("inf")
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(d / "net")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4).astype(np.float32)
+    want = np.asarray(model(paddle.to_tensor(x)).numpy())
+    return path, x, want
+
+
+class TestPredictor:
+    def test_run_direct(self, saved_model):
+        path, x, want = saved_model
+        cfg = inference.Config(path)
+        pred = inference.create_predictor(cfg)
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], want, atol=1e-6)
+
+    def test_run_with_handles(self, saved_model):
+        path, x, want = saved_model
+        pred = inference.create_predictor(inference.Config(path))
+        names = pred.get_input_names()
+        assert len(names) == 1
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        assert pred.run() is True
+        out_names = pred.get_output_names()
+        out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, want, atol=1e-6)
+
+    def test_config_knobs(self, saved_model):
+        path, _, _ = saved_model
+        cfg = inference.Config(path + ".pdmodel")
+        cfg.enable_use_gpu(256)
+        cfg.switch_ir_optim(True)
+        cfg.enable_memory_optim()
+        cfg.set_cpu_math_library_num_threads(4)
+        assert cfg.use_gpu() and cfg.ir_optim()
+        assert cfg.prog_file().endswith(".pdmodel")
+        assert cfg.params_file().endswith(".pdiparams")
+        pred = inference.create_predictor(cfg)
+        assert pred.get_input_names()

@@ -252,3 +252,54 @@ class TestPipelineMemoryBound:
         # 4x more microbatches must not cost more live activation memory
         # (remat bounds live state to per-tick stage inputs, total ∝ batch)
         assert t8 <= t2 * 1.25, (t2, t8)
+
+
+class TestInterleavedSchedule:
+    """num_virtual_pipeline_stages=v: the interleaved schedule must compute
+    exactly what the sequential stack computes (values AND grads), with a
+    (P-1)/(vM+P-1) bubble (beyond-reference; the reference ships plain
+    1F1B)."""
+
+    def _build(self, hybrid_pp, v):
+        hcg, _ = hybrid_pp
+        paddle.seed(0)
+        pipe = PipelineLayer(
+            [nn.Linear(8, 16)] + [LayerDesc(Block) for _ in range(4)]
+            + [nn.Linear(16, 4)],
+            topology=hcg.topology(), loss_fn=_loss,
+            num_virtual_pipeline_stages=v)
+        return pipe, fleet.distributed_model(pipe)
+
+    def test_virtual_stages_engaged(self, hybrid_pp):
+        pipe, model = self._build(hybrid_pp, 2)
+        assert model._use_schedule
+        assert model.num_virtual == 2
+
+    def test_forward_matches_sequential(self, hybrid_pp):
+        pipe, model = self._build(hybrid_pp, 2)
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        np.testing.assert_allclose(model(x).numpy(), pipe(x).numpy(),
+                                   atol=1e-5)
+
+    @pytest.mark.slow
+    def test_grads_match_sequential(self, hybrid_pp):
+        pipe, model = self._build(hybrid_pp, 2)
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+        _loss(model(x), y).backward()
+        g_pipe = {n: p.grad.numpy().copy()
+                  for n, p in pipe.named_parameters()}
+        for p in pipe.parameters():
+            p.clear_grad()
+        _loss(pipe(x), y).backward()
+        for n, p in pipe.named_parameters():
+            np.testing.assert_allclose(g_pipe[n], p.grad.numpy(),
+                                       atol=1e-5, err_msg=n)
+
+    def test_indivisible_degrades_to_v1(self, hybrid_pp):
+        # 4 body layers cannot split into 2 stages x 4 chunks
+        pipe, model = self._build(hybrid_pp, 4)
+        assert model.num_virtual == 1
+        assert model._use_schedule

@@ -1,0 +1,146 @@
+"""paddle.text: viterbi_decode vs brute force; dataset parsers on locally
+generated files in the reference formats (no downloads in this env)."""
+import itertools
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (
+    Imdb, Imikolov, UCIHousing, ViterbiDecoder, viterbi_decode,
+)
+
+
+def _brute_force(pot, trans, length, include):
+    S, N = pot.shape
+    start, stop = N - 1, N - 2
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(N), repeat=length):
+        s = pot[0, path[0]] + (trans[start, path[0]] if include else 0.0)
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include:
+            s += trans[path[-1], stop]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("include", [False, True])
+    def test_matches_brute_force(self, include):
+        rs = np.random.RandomState(0)
+        B, S, N = 3, 5, 4
+        pot = rs.rand(B, S, N).astype(np.float32)
+        trans = rs.rand(N, N).astype(np.float32)
+        lengths = np.array([5, 3, 1], dtype=np.int64)
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=include)
+        scores = np.asarray(scores.numpy())
+        paths = np.asarray(paths.numpy())
+        assert paths.shape == (3, 5)
+        def _path_score(pot_b, p, L):
+            N = pot_b.shape[1]
+            s = pot_b[0, p[0]] + (trans[N - 1, p[0]] if include else 0.0)
+            for t in range(1, L):
+                s += trans[p[t - 1], p[t]] + pot_b[t, p[t]]
+            if include:
+                s += trans[p[-1], N - 2]
+            return s
+
+        for b in range(B):
+            want_s, _ = _brute_force(pot[b], trans, int(lengths[b]), include)
+            np.testing.assert_allclose(scores[b], want_s, rtol=1e-5)
+            # the returned path must ACHIEVE the optimal score (argmax
+            # tie-breaking may differ from brute-force enumeration order)
+            L = int(lengths[b])
+            got = _path_score(pot[b], list(paths[b][:L]), L)
+            np.testing.assert_allclose(got, want_s, rtol=1e-5)
+            assert (paths[b][lengths[b]:] == 0).all()
+
+    def test_layer_wrapper(self):
+        rs = np.random.RandomState(1)
+        trans = paddle.to_tensor(rs.rand(4, 4).astype(np.float32))
+        dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pot = paddle.to_tensor(rs.rand(2, 4, 4).astype(np.float32))
+        lens = paddle.to_tensor(np.array([4, 4], dtype=np.int64))
+        scores, path = dec(pot, lens)
+        assert tuple(path.shape) == (2, 4)
+
+
+class TestDatasets:
+    def test_uci_housing_local(self, tmp_path):
+        rs = np.random.RandomState(0)
+        raw = rs.rand(50, 14).astype(np.float32)
+        f = tmp_path / "housing.data"
+        np.savetxt(f, raw)
+        train = UCIHousing(data_file=str(f), mode="train")
+        test = UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_download_unavailable_raises(self):
+        with pytest.raises(RuntimeError, match="data_file"):
+            UCIHousing(mode="train")
+
+    def test_imdb_local(self, tmp_path):
+        root = tmp_path / "aclImdb"
+        texts = {
+            "train/pos/0.txt": "a good good movie the the the best",
+            "train/pos/1.txt": "good the fine a",
+            "train/neg/0.txt": "a bad the movie the worst the",
+            "test/pos/0.txt": "good the",
+            "test/neg/0.txt": "bad the a",
+        }
+        for rel, content in texts.items():
+            p = root / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(content)
+        tgz = tmp_path / "aclImdb_v1.tar.gz"
+        with tarfile.open(tgz, "w:gz") as tf:
+            tf.add(root, arcname="aclImdb")
+        ds = Imdb(data_file=str(tgz), mode="train", cutoff=2)
+        assert len(ds) == 3
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        # counts in train: the=7, a=3, good=3 — all above the cutoff of 2
+        assert set(ds.word_idx) == {"the", "a", "good"}
+        assert ds.word_idx["the"] == 0
+
+    def test_imikolov_local(self, tmp_path):
+        lines_train = ["the cat sat on the mat"] * 30 + \
+            ["a dog ran fast"] * 20
+        lines_valid = ["the dog sat"] * 5
+        lines_test = ["the cat ran"] * 4
+        root = tmp_path / "simple-examples" / "data"
+        root.mkdir(parents=True)
+        (root / "ptb.train.txt").write_text("\n".join(lines_train))
+        (root / "ptb.valid.txt").write_text("\n".join(lines_valid))
+        (root / "ptb.test.txt").write_text("\n".join(lines_test))
+        tgz = tmp_path / "simple-examples.tar.gz"
+        with tarfile.open(tgz, "w:gz") as tf:
+            tf.add(tmp_path / "simple-examples", arcname="./simple-examples")
+        ds = Imikolov(data_file=str(tgz), data_type="NGRAM", window_size=2,
+                      mode="train", min_word_freq=10)
+        assert len(ds) > 0
+        gram = ds[0]
+        # reference contract: exactly window_size ids per item
+        assert gram.shape == (2,)
+        # <s>/<e> are counted once per line (55 lines) > cutoff, so they
+        # rank as regular frequency-ordered vocab entries
+        assert "<s>" in ds.word_idx and "<e>" in ds.word_idx
+        assert ds.word_idx["<unk>"] == len(ds.word_idx) - 1
+        seq = Imikolov(data_file=str(tgz), data_type="SEQ", mode="test",
+                       min_word_freq=10)
+        assert len(seq) == 4  # reads ptb.test.txt
+        src, tgt = seq[0]
+        assert len(src) == len(tgt)
+        # window_size filter drops over-long sequences in SEQ mode
+        seq2 = Imikolov(data_file=str(tgz), data_type="SEQ", mode="train",
+                        window_size=3, min_word_freq=10)
+        assert all(len(s) <= 3 for s, _ in
+                   (seq2[i] for i in range(len(seq2))))
