@@ -58,38 +58,55 @@ def main():
         cfg = gpt2_345m(recompute=False, hidden_dropout_prob=0.0,
                         attention_probs_dropout_prob=0.0)
         seq = 1024
-    batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "16")) \
-        * len(jax.devices())
+    per_chip = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "16"))
     model = fleet.distributed_model(GPTForCausalLM(cfg))
     opt = fleet.distributed_optimizer(
         paddle.optimizer.AdamW(learning_rate=1e-4,
                                parameters=model.parameters()))
 
-    @paddle.jit.to_static
-    def train_step(x, y):
-        with paddle.amp.auto_cast(dtype="bfloat16"):
-            loss = model.compute_loss(x, y)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
-
     rs = np.random.RandomState(0)
-    x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
-    y = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
 
-    # warmup (compile) + steady-state timing
-    for _ in range(3):
-        loss = train_step(x, y)
-    float(loss)
-    n_iters = 10
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        loss = train_step(x, y)
-    float(loss)  # sync
-    dt = (time.perf_counter() - t0) / n_iters
+    def run_at(batch):
+        @paddle.jit.to_static
+        def train_step(x, y):
+            with paddle.amp.auto_cast(dtype="bfloat16"):
+                loss = model.compute_loss(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
 
-    import jax
+        x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
+        y = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
+        for _ in range(3):          # warmup (compile)
+            loss = train_step(x, y)
+        float(loss)
+        n_iters = 10
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            loss = train_step(x, y)
+        float(loss)  # sync
+        return (time.perf_counter() - t0) / n_iters, loss
+
+    # halve the batch on OOM rather than failing the whole bench
+    dt = loss = None
+    while per_chip >= 1:
+        batch = per_chip * len(jax.devices())
+        try:
+            dt, loss = run_at(batch)
+            break
+        except Exception as e:  # XlaRuntimeError RESOURCE_EXHAUSTED etc.
+            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" \
+                    not in str(e) and "OOM" not in str(e):
+                raise
+            import sys
+
+            print(f"bench: batch {per_chip}/chip OOM, halving",
+                  file=sys.stderr)
+            per_chip //= 2
+    if dt is None:
+        raise RuntimeError("bench could not fit even batch 1/chip")
+
     n_chips = max(len(jax.devices()), 1)
     tokens_per_sec = batch * seq / dt / n_chips  # per-chip, honest on pods
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())

@@ -100,7 +100,14 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     hcg = get_hybrid_communicate_group()
-    return HybridParallelOptimizer(optimizer, hcg, strategy or _user_strategy)
+    s = strategy or _user_strategy
+    opt = HybridParallelOptimizer(optimizer, hcg, s)
+    if s is not None and getattr(s, "gradient_merge", False):
+        from .meta_optimizers import GradientMergeOptimizer
+
+        cfg = s.gradient_merge_configs
+        opt = GradientMergeOptimizer(opt, k_steps=cfg.k_steps, avg=cfg.avg)
+    return opt
 
 
 # -- worker info (reference fleet_base worker_num/worker_index) -------------

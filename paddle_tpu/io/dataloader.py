@@ -11,6 +11,7 @@ owns the device; the host queue is plain multiprocessing).
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from dataclasses import dataclass
@@ -107,8 +108,11 @@ def _tensor_ify(obj):
     return obj
 
 
+_SHM_MARKER = "__shm_ring__"
+
+
 def _worker_loop(dataset, index_queue, result_queue, collate_fn,
-                 worker_init_fn, worker_id, num_workers):
+                 worker_init_fn, worker_id, num_workers, ring_name=None):
     global _worker_info
     # Defense in depth against the single-client TPU tunnel (see
     # numpy_collate_fn): if anything in this child does touch jax, make it
@@ -119,21 +123,45 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn,
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    ring = None
+    if ring_name is not None:
+        try:
+            from .native import ShmRing
+
+            ring = ShmRing(ring_name)
+        except Exception:
+            ring = None
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
+    import pickle
+
     while True:
         item = index_queue.get()
         if item is None:
             break
         batch_id, indices = item
         try:
-            data = _fetch_batch(dataset, indices, collate_fn)
-            result_queue.put((batch_id, _np_ify(data), None))
-        except Exception as e:  # propagate to parent
+            data = _np_ify(_fetch_batch(dataset, indices, collate_fn))
+            if ring is not None:
+                # bulk payload rides the native shared-memory ring; the
+                # queue carries only the control tuple (reference: C++
+                # blocking_queue + shm numpy transport)
+                try:
+                    ring.push(pickle.dumps(
+                        (batch_id, data), protocol=pickle.HIGHEST_PROTOCOL))
+                    result_queue.put(
+                        (batch_id, (_SHM_MARKER, worker_id), None))
+                    continue
+                except ValueError:   # batch larger than the ring
+                    pass
+            result_queue.put((batch_id, data, None))
+        except Exception:  # propagate to parent
             import traceback
 
             result_queue.put((batch_id, None, traceback.format_exc()))
+    if ring is not None:
+        ring.close_producer()
 
 
 class _MultiProcessIter:
@@ -154,12 +182,43 @@ class _MultiProcessIter:
         # workers get the numpy collate unless the user supplied one
         wcollate = (numpy_collate_fn if loader.collate_fn
                     is default_collate_fn else loader.collate_fn)
+        # native shared-memory transport: one SPSC ring per worker (see
+        # io/native/shm_ring.cc); queue degrades gracefully when the
+        # toolchain or shm is unavailable
+        self.rings = [None] * n
+        ring_names = [None] * n
+        if loader.use_shared_memory:
+            try:
+                from .native import ShmRing, available
+
+                # size rings to the tmpfs actually backing /dev/shm: the
+                # segment is sparse at create time, so over-allocation
+                # would SIGBUS on first touch instead of failing cleanly
+                cap = 64 * 1024 * 1024
+                try:
+                    st = os.statvfs("/dev/shm")
+                    free = st.f_bavail * st.f_frsize
+                    cap = min(cap, int(free * 0.5) // max(n, 1))
+                except OSError:
+                    pass
+                if available() and cap >= 1 * 1024 * 1024:
+                    import uuid
+
+                    base = f"/ptpu_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+                    for wid in range(n):
+                        name = f"{base}_{wid}"
+                        self.rings[wid] = ShmRing(name, capacity=cap,
+                                                  create=True)
+                        ring_names[wid] = name
+            except Exception:
+                self.rings = [None] * n
+                ring_names = [None] * n
         for wid in range(n):
             iq = ctx.Queue()
             w = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, iq, self.result_queue, wcollate,
-                      loader.worker_init_fn, wid, n),
+                      loader.worker_init_fn, wid, n, ring_names[wid]),
                 daemon=True,
             )
             w.start()
@@ -190,6 +249,14 @@ class _MultiProcessIter:
             if err is not None:
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            if isinstance(data, tuple) and len(data) == 2 and \
+                    data[0] == _SHM_MARKER:
+                import pickle
+
+                payload = self.rings[data[1]].pop(
+                    timeout_ms=int((self.loader.timeout or 600) * 1000))
+                rid, data = pickle.loads(payload)
+                assert rid == batch_id, (rid, batch_id)
             self.reorder[batch_id] = data
         data = self.reorder.pop(self.next_yield)
         self.next_yield += 1
@@ -207,6 +274,13 @@ class _MultiProcessIter:
             if w.is_alive():
                 w.terminate()
         self.workers = []
+        for r in getattr(self, "rings", []):
+            if r is not None:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+        self.rings = []
 
     def __del__(self):
         try:
@@ -230,6 +304,7 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.use_spawn = True
+        self.use_shared_memory = bool(use_shared_memory)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None

@@ -68,43 +68,45 @@ class Imdb(Dataset):
         assert mode in ("train", "test")
         path = _require(data_file, "Imdb",
                         "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz")
-        pat = re.compile(rf"aclImdb/{mode}/pos/.*\.txt$")
+        # one decompression pass collects the vocab counts (train split)
+        # and this mode's tokenized documents together — the tarball is
+        # ~50k files and re-scanning it per purpose triples load time
+        from collections import Counter
+
+        pos_pat = re.compile(rf"aclImdb/{mode}/pos/.*\.txt$")
         neg_pat = re.compile(rf"aclImdb/{mode}/neg/.*\.txt$")
-        self.word_idx = self._build_vocab(path, cutoff)
+        train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        freq: Counter = Counter()
+        pos_docs: List[List[str]] = []
+        neg_docs: List[List[str]] = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                name = m.name or ""
+                is_pos = bool(pos_pat.match(name))
+                is_neg = bool(neg_pat.match(name))
+                is_train = bool(train_pat.match(name))
+                if not (is_pos or is_neg or is_train):
+                    continue
+                words = tf.extractfile(m).read().decode("latin-1") \
+                    .lower().replace("<br />", " ").split()
+                if is_train:
+                    freq.update(words)
+                if is_pos:
+                    pos_docs.append(words)
+                elif is_neg:
+                    neg_docs.append(words)
+        freq.pop("<unk>", None)
+        vocab = [w for w, c in freq.items() if c > cutoff]
+        vocab.sort(key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
         self.docs: List[np.ndarray] = []
         self.labels: List[int] = []
-        for docs, label in ((self._tokenize(path, pat), 0),
-                            (self._tokenize(path, neg_pat), 1)):
-            unk = len(self.word_idx)
+        for docs, label in ((pos_docs, 0), (neg_docs, 1)):
             for d in docs:
                 self.docs.append(np.array(
                     [self.word_idx.get(w, unk) for w in d], dtype=np.int64))
                 self.labels.append(label)
-
-    @staticmethod
-    def _tokenize(path, pattern) -> List[List[str]]:
-        out = []
-        with tarfile.open(path) as tf:
-            for m in tf.getmembers():
-                if pattern.match(m.name or ""):
-                    data = tf.extractfile(m).read().decode("latin-1")
-                    out.append(data.lower().replace("<br />", " ").split())
-        return out
-
-    def _build_vocab(self, path, cutoff):
-        from collections import Counter
-
-        freq = Counter()
-        with tarfile.open(path) as tf:
-            for m in tf.getmembers():
-                if re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name or ""):
-                    words = tf.extractfile(m).read().decode("latin-1") \
-                        .lower().replace("<br />", " ").split()
-                    freq.update(words)
-        freq.pop("<unk>", None)
-        words = [w for w, c in freq.items() if c > cutoff]
-        words.sort(key=lambda w: (-freq[w], w))
-        return {w: i for i, w in enumerate(words)}
 
     def __len__(self):
         return len(self.docs)
