@@ -90,5 +90,17 @@ class GradientMergeOptimizer:
 
     def set_state_dict(self, state_dict):
         state_dict = dict(state_dict)
-        self._count = int(state_dict.pop("@gradient_merge_count", 0))
+        saved = int(state_dict.pop("@gradient_merge_count", 0))
+        # accumulated grads live on the (dead) process's parameters, not in
+        # the state dict — restoring a mid-cycle count would make the next
+        # apply use a truncated, mis-averaged update.  Start a fresh
+        # accumulation window instead.
+        if saved:
+            import warnings
+
+            warnings.warn(
+                f"gradient-merge checkpoint was taken mid-cycle "
+                f"({saved}/{self._k} micro-steps); restarting the "
+                f"accumulation window (partial gradients were not saved)")
+        self._count = 0
         self._inner.set_state_dict(state_dict)

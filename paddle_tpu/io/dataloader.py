@@ -255,8 +255,17 @@ class _MultiProcessIter:
 
                 payload = self.rings[data[1]].pop(
                     timeout_ms=int((self.loader.timeout or 600) * 1000))
+                if payload is None:
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker closed its shm ring before "
+                        "delivering a announced batch")
                 rid, data = pickle.loads(payload)
-                assert rid == batch_id, (rid, batch_id)
+                if rid != batch_id:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"shm ring desync: expected batch {batch_id}, "
+                        f"got {rid}")
             self.reorder[batch_id] = data
         data = self.reorder.pop(self.next_yield)
         self.next_yield += 1

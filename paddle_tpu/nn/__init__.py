@@ -16,8 +16,8 @@ from .layer.common import (
 from .layer.activation import (
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, RReLU,
-    SELU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh,
-    Tanhshrink, ThresholdedReLU,
+    SELU, Sigmoid, Silu, Softmax, Softmax2D, Softplus, Softshrink, Softsign,
+    Swish, Tanh, Tanhshrink, ThresholdedReLU,
 )
 from .layer.conv import (
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
@@ -31,13 +31,14 @@ from .layer.pooling import (
     AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.loss import (
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
     CosineEmbeddingLoss, TripletMarginLoss, TripletMarginWithDistanceLoss,
     SoftMarginLoss, MultiLabelSoftMarginLoss, CTCLoss, PoissonNLLLoss,
-    GaussianNLLLoss,
+    GaussianNLLLoss, HSigmoidLoss,
 )
 from .layer.rnn import (
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,

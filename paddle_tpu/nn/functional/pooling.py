@@ -17,6 +17,7 @@ __all__ = [
     "max_pool1d", "max_pool2d", "max_pool3d",
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
     "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
 ]
 
 
@@ -82,22 +83,138 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  ceil_mode, exclusive, data_format)
 
 
+def _max_pool_with_mask(name, x, nd, kernel, stride, padding, ceil_mode,
+                        data_format):
+    """Max pool returning (out, mask) where mask holds each max's flat
+    index into the input's spatial plane (reference: max_pool*d
+    return_mask=True contract, used by max_unpool*d).  Windows are
+    extracted as patches for the exact argmax; the flat index is then
+    RECONSTRUCTED in integer arithmetic from (output position, window
+    offset) — no float index tensor, so indices stay exact at any size."""
+    if ceil_mode:
+        raise NotImplementedError("return_mask with ceil_mode is not "
+                                  "supported")
+    if data_format not in (None, "NCL", "NCW", "NCHW", "NCDHW"):
+        raise NotImplementedError(
+            f"return_mask requires channel-first layout, got {data_format}")
+    k = _ntuple(kernel, nd)
+    s = _ntuple(stride if stride is not None else kernel, nd)
+    p = _resolve_padding(padding, nd)
+    if isinstance(p, str):
+        raise ValueError("return_mask does not support string padding")
+
+    def _primal(a):
+        N, C = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        pads = [(0, 0), (0, 0)] + list(p)
+        # finite lowest, NOT -inf: patch extraction is a one-hot conv and
+        # -inf * 0 would poison every patch with NaN
+        lowest = jnp.finfo(a.dtype).min if jnp.issubdtype(
+            a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        av = jnp.pad(a, pads, constant_values=lowest)
+        pv = jax.lax.conv_general_dilated_patches(
+            av, filter_shape=k, window_strides=s, padding=[(0, 0)] * nd)
+        out_sp = pv.shape[2:]
+        kk = int(np.prod(k))
+        pv = pv.reshape(N, C, kk, *out_sp)
+        arg = jnp.argmax(pv, axis=2)                       # [N, C, *out]
+        out = jnp.take_along_axis(pv, arg[:, :, None], axis=2).squeeze(2)
+        # flat input index = Σ_d (out_pos_d * stride_d - pad_d + off_d)
+        # * plane_stride_d  (the max can never sit in padding: -inf)
+        in_strides = np.cumprod((list(spatial[1:]) + [1])[::-1])[::-1]
+        mask = jnp.zeros(arg.shape, jnp.int32)
+        rem = arg
+        for d in range(nd):
+            tail = int(np.prod(k[d + 1:])) if d + 1 < nd else 1
+            off_d = (rem // tail).astype(jnp.int32)
+            rem = rem % tail
+            pos_d = jnp.arange(out_sp[d], dtype=jnp.int32) * s[d] - p[d][0] \
+                if isinstance(p[d], (tuple, list)) else \
+                jnp.arange(out_sp[d], dtype=jnp.int32) * s[d] - p[d]
+            shape = [1] * (2 + nd)
+            shape[2 + d] = out_sp[d]
+            coord = off_d + pos_d.reshape(shape)
+            mask = mask + coord * int(in_strides[d])
+        return out.astype(a.dtype), mask
+
+    return op(name, _primal, [x], n_outs=2)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_with_mask("max_pool1d", x, 1, kernel_size, stride,
+                                   padding, ceil_mode, None)
     return _pool("max_pool1d", x, 1, kernel_size, stride, padding, "max",
                  ceil_mode, True, "NCW")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask("max_pool2d", x, 2, kernel_size, stride,
+                                   padding, ceil_mode, data_format)
     return _pool("max_pool2d", x, 2, kernel_size, stride, padding, "max",
                  ceil_mode, True, data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask("max_pool3d", x, 3, kernel_size, stride,
+                                   padding, ceil_mode, data_format)
     return _pool("max_pool3d", x, 3, kernel_size, stride, padding, "max",
                  ceil_mode, True, data_format)
+
+
+def _max_unpool(name, x, indices, nd, kernel, stride, padding, output_size):
+    """Scatter pooled values back to their argmax positions (reference:
+    max_unpool*d ← phi unpool kernels)."""
+    k = _ntuple(kernel, nd)
+    s = _ntuple(stride if stride is not None else kernel, nd)
+
+    p = _resolve_padding(padding, nd)
+    if isinstance(p, str):
+        raise ValueError("max_unpool does not support string padding")
+    plo = [pp[0] if isinstance(pp, (tuple, list)) else pp for pp in p]
+
+    def _primal(a, idx):
+        N, C = a.shape[0], a.shape[1]
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size)[-nd:]
+        else:
+            # reference formula: (in-1)*stride - 2*padding + kernel
+            out_sp = tuple((i - 1) * st - 2 * pd + kk
+                           for i, st, pd, kk in zip(in_sp, s, plo, k))
+        flat = int(np.prod(out_sp))
+        vals = a.reshape(N, C, -1)
+        ii = idx.reshape(N, C, -1).astype(jnp.int32)
+        out = jnp.zeros((N, C, flat), a.dtype)
+        bidx = jnp.arange(N)[:, None, None]
+        cidx = jnp.arange(C)[None, :, None]
+        out = out.at[bidx, cidx, ii].set(vals)
+        return out.reshape(N, C, *out_sp)
+
+    return op(name, _primal, [x, indices])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool("max_unpool1d", x, indices, 1, kernel_size, stride,
+                       padding, output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool("max_unpool2d", x, indices, 2, kernel_size, stride,
+                       padding, output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool("max_unpool3d", x, indices, 3, kernel_size, stride,
+                       padding, output_size)
 
 
 def _adaptive(name, x, nd, output_size, mode, data_format):

@@ -72,3 +72,18 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference:
+    activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim != 4 and x.ndim != 3:
+            raise ValueError(f"Softmax2D expects 3-D/4-D input, got {x.ndim}-D")
+        from .. import functional as F
+
+        return F.softmax(x, axis=-3)

@@ -207,3 +207,35 @@ class GaussianNLLLoss(Layer):
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, self.full,
                                    self.epsilon, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference: loss.py
+    HSigmoidLoss → functional hsigmoid_loss; default complete binary
+    tree over num_classes)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        from .. import initializer as I
+
+        self.num_classes = num_classes
+        n_nodes = num_classes - 1
+        init = weight_attr if weight_attr is not None else I.Normal(std=0.02)
+        import numpy as _np
+
+        self.weight = self.create_parameter(
+            [n_nodes, feature_size], attr=init)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [n_nodes, 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):
+        from .. import functional as F
+
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)

@@ -1,0 +1,185 @@
+"""Remaining paddle.* tensor-API surface (reference: python/paddle/tensor —
+the exports not covered by the math/linalg/manipulation/... families)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import as_int_list, nondiff, op, unwrap
+
+__all__ = [
+    "add_n", "broadcast_shape", "check_shape", "diagonal", "is_complex",
+    "is_floating_point", "is_integer", "logit", "multiplex", "nanquantile",
+    "quantile", "rank", "renorm", "set_printoptions", "slice",
+    "strided_slice", "tanh_", "tolist", "unstack",
+]
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference: math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+
+    def _primal(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    return op("add_n", _primal, list(inputs))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def check_shape(shape):
+    """Reference: layers/utils check_shape — validates a shape argument."""
+    for s in as_int_list(shape):
+        if s < -1 or s == 0:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+    return True
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return op("diagonal",
+              lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), [x])
+
+
+def _dtype_kind(x) -> str:
+    return np.dtype(unwrap(x).dtype).kind
+
+
+def is_complex(x):
+    return _dtype_kind(x) == "c"
+
+
+def is_floating_point(x):
+    return _dtype_kind(x) == "f"
+
+
+def is_integer(x):
+    return _dtype_kind(x) in "iu"
+
+
+def logit(x, eps=None, name=None):
+    def _primal(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+
+    return op("logit", _primal, [x])
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across candidate tensors (reference: math.py
+    multiplex — out[i] = inputs[index[i]][i])."""
+
+    def _primal(idx, *cands):
+        stacked = jnp.stack(cands, axis=0)          # [C, B, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return op("multiplex", _primal, [index] + list(inputs))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return op("quantile",
+              lambda a: jnp.quantile(
+                  a, jnp.asarray(q), axis=axis, keepdims=keepdim),
+              [x])
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return op("nanquantile",
+              lambda a: jnp.nanquantile(
+                  a, jnp.asarray(q), axis=axis, keepdims=keepdim),
+              [x])
+
+
+def rank(input, name=None):
+    return nondiff("rank", lambda a: jnp.asarray(a.ndim, jnp.int32), [input])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (reference: math.py renorm)."""
+
+    def _primal(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return op("renorm", _primal, [x])
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: framework set_printoptions — tensor repr formatting."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+_py_slice = slice  # captured before this module's `slice` shadows it
+
+
+def slice(input, axes, starts, ends, name=None):
+    """Reference: paddle.slice — slab [starts, ends) along `axes`."""
+    axes = as_int_list(axes)
+    starts = as_int_list(starts)
+    ends = as_int_list(ends)
+
+    def _primal(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = _py_slice(st, en)
+        return a[tuple(idx)]
+
+    return op("slice", _primal, [input])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = as_int_list(axes)
+    starts = as_int_list(starts)
+    ends = as_int_list(ends)
+    strides = as_int_list(strides)
+
+    def _primal(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = _py_slice(st, en, sd)
+        return a[tuple(idx)]
+
+    return op("strided_slice", _primal, [x])
+
+
+def tanh_(x, name=None):
+    x._set_data(jnp.tanh(x._value()))
+    return x
+
+
+def tolist(x):
+    return np.asarray(unwrap(x)).tolist()
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else unwrap(x).shape[axis]
+    return op("unstack",
+              lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+              [x], n_outs=n)

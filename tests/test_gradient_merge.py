@@ -89,8 +89,11 @@ class TestGradientMerge:
         gm2 = GradientMergeOptimizer(
             paddle.optimizer.AdamW(learning_rate=0.1,
                                    parameters=model.parameters()), k_steps=2)
-        gm2.set_state_dict(sd)
-        assert gm2._count == 1
+        # mid-cycle restores restart the accumulation window (the partial
+        # grads died with the saving process) and warn about it
+        with pytest.warns(UserWarning, match="mid-cycle"):
+            gm2.set_state_dict(sd)
+        assert gm2._count == 0
 
     def test_under_tracing_raises(self):
         model, X, Y = _model_and_data()

@@ -1,0 +1,187 @@
+"""The remaining nn surface: max-pool masks + unpool, grid ops, hsigmoid,
+margin CE, gather_tree, bilinear, diag_embed, Softmax2D (reference:
+python/paddle/nn — the last uncovered exports)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestMaxPoolMaskUnpool:
+    def test_mask_points_at_max(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 3, 8, 8).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+        xa = np.asarray(x.numpy())
+        o = np.asarray(out.numpy())
+        m = np.asarray(mask.numpy())
+        assert o.shape == (2, 3, 4, 4) and m.shape == (2, 3, 4, 4)
+        flat = xa.reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, m.reshape(2, 3, -1), axis=2)
+            .reshape(o.shape), o)
+
+    def test_unpool_roundtrip(self):
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(1, 2, 6, 6).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+        up = F.max_unpool2d(out, mask, 2, stride=2)
+        ua = np.asarray(up.numpy())
+        assert ua.shape == (1, 2, 6, 6)
+        # every pooled max lands back at its original position
+        oa = np.asarray(out.numpy())
+        assert np.isclose(np.sort(ua[ua != 0]),
+                          np.sort(oa.reshape(-1))).all()
+        # layer wrappers
+        l = nn.MaxUnPool2D(2, stride=2)
+        np.testing.assert_allclose(np.asarray(l(out, mask).numpy()), ua)
+
+    def test_unpool_with_padding_restores_input_shape(self):
+        rs = np.random.RandomState(3)
+        x = paddle.to_tensor(rs.randn(1, 1, 4, 4).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, stride=2, padding=1,
+                                 return_mask=True)
+        up = F.max_unpool2d(out, mask, 2, stride=2, padding=1)
+        ua = np.asarray(up.numpy())
+        assert ua.shape == (1, 1, 4, 4)
+        # each kept max sits at exactly its original coordinate
+        xa = np.asarray(x.numpy())
+        nz = ua != 0
+        np.testing.assert_allclose(ua[nz], xa[nz])
+
+    def test_mask_ceil_mode_raises(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 5, 5), np.float32))
+        with pytest.raises(NotImplementedError):
+            F.max_pool2d(x, 2, stride=2, ceil_mode=True, return_mask=True)
+        with pytest.raises(NotImplementedError):
+            F.max_pool2d(x, 2, stride=2, data_format="NHWC",
+                         return_mask=True)
+
+    def test_unpool_1d_3d(self):
+        rs = np.random.RandomState(2)
+        x1 = paddle.to_tensor(rs.randn(1, 2, 8).astype(np.float32))
+        o1, m1 = F.max_pool1d(x1, 2, return_mask=True)
+        assert tuple(F.max_unpool1d(o1, m1, 2).shape) == (1, 2, 8)
+        x3 = paddle.to_tensor(rs.randn(1, 1, 4, 4, 4).astype(np.float32))
+        o3, m3 = F.max_pool3d(x3, 2, return_mask=True)
+        assert tuple(F.max_unpool3d(o3, m3, 2).shape) == (1, 1, 4, 4, 4)
+
+
+class TestGridOps:
+    def test_affine_grid_identity(self):
+        theta = paddle.to_tensor(
+            np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (1, 1, 1)))
+        grid = F.affine_grid(theta, [1, 1, 4, 4])
+        g = np.asarray(grid.numpy())
+        assert g.shape == (1, 4, 4, 2)
+        np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+
+    def test_grid_sample_identity(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(1, 2, 5, 5).astype(np.float32))
+        theta = paddle.to_tensor(
+            np.array([[[1, 0, 0], [0, 1, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 2, 5, 5])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(x.numpy()), atol=1e-5)
+
+    def test_grid_sample_nearest_and_border(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        grid = paddle.to_tensor(
+            np.array([[[[2.0, 2.0]]]], np.float32))  # far out of range
+        z = F.grid_sample(x, grid, mode="nearest")
+        b = F.grid_sample(x, grid, mode="nearest", padding_mode="border")
+        assert float(z.sum()) == 0.0
+        assert float(b.sum()) == 15.0
+
+
+class TestMiscNN:
+    def test_bilinear(self):
+        rs = np.random.RandomState(0)
+        a = rs.randn(4, 3).astype(np.float32)
+        b = rs.randn(4, 5).astype(np.float32)
+        w = rs.randn(2, 3, 5).astype(np.float32)
+        out = F.bilinear(paddle.to_tensor(a), paddle.to_tensor(b),
+                         paddle.to_tensor(w))
+        want = np.einsum("bi,kij,bj->bk", a, w, b)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want, atol=1e-5)
+
+    def test_diag_embed(self):
+        v = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        out = np.asarray(F.diag_embed(v).numpy())
+        assert out.shape == (2, 2, 2)
+        np.testing.assert_allclose(out[0], np.diag([1.0, 2.0]))
+
+    def test_gather_tree(self):
+        ids = paddle.to_tensor(np.array(
+            [[[2, 2]], [[3, 4]], [[5, 6]]], np.int64))      # [T=3,B=1,beam=2]
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[1, 0]], [[1, 0]]], np.int64))
+        out = np.asarray(F.gather_tree(ids, parents).numpy())
+        assert out.shape == (3, 1, 2)
+        # beam 0 final: t2 id 5 with parent 1 → t1 id 4 (parent idx 1),
+        # whose parent 0 → t0 id 2
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 4, 5])
+
+    def test_softmax2d_layer(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 4, 4).astype(np.float32))
+        out = nn.Softmax2D()(x)
+        s = np.asarray(out.numpy()).sum(axis=1)
+        np.testing.assert_allclose(s, 1.0, atol=1e-5)
+
+    def test_hsigmoid_loss(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 6)
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+        x.stop_gradient = False
+        y = paddle.to_tensor(rs.randint(0, 6, (4, 1)).astype(np.int64))
+        loss = layer(x, y)
+        assert tuple(loss.shape) == (4, 1)
+        assert (np.asarray(loss.numpy()) > 0).all()
+        loss.sum().backward()
+        assert layer.weight.grad is not None and x.grad is not None
+
+    def test_margin_cross_entropy(self):
+        rs = np.random.RandomState(0)
+        # cosine logits in [-1, 1]
+        logits = paddle.to_tensor(
+            (rs.rand(6, 10).astype(np.float32) * 2 - 1) * 0.9)
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(rs.randint(0, 10, (6,)).astype(np.int64))
+        loss = F.margin_cross_entropy(logits, labels)
+        assert float(loss) > 0
+        loss.backward()
+        assert logits.grad is not None
+        # margins=identity + scale=1 reduces to plain softmax CE
+        plain = F.margin_cross_entropy(
+            logits, labels, margin1=1.0, margin2=0.0, margin3=0.0,
+            scale=1.0, reduction="mean")
+        ref = F.cross_entropy(
+            logits.astype("float32"), labels, reduction="mean")
+        np.testing.assert_allclose(float(plain), float(ref), rtol=1e-5)
+
+    def test_sparse_attention_matches_dense_full_pattern(self):
+        rs = np.random.RandomState(0)
+        B, H, S, D = 1, 2, 4, 8
+        q = paddle.to_tensor(rs.randn(B, H, S, D).astype(np.float32))
+        k = paddle.to_tensor(rs.randn(B, H, S, D).astype(np.float32))
+        v = paddle.to_tensor(rs.randn(B, H, S, D).astype(np.float32))
+        # full CSR pattern == dense attention
+        offs = paddle.to_tensor(
+            np.tile(np.arange(0, S * S + 1, S, dtype=np.int32), (B, H, 1)))
+        cols = paddle.to_tensor(
+            np.tile(np.tile(np.arange(S, dtype=np.int32), S), (B, H, 1)))
+        out = F.sparse_attention(q, k, v, offs, cols)
+        qa, ka, va = (np.asarray(t.numpy()) for t in (q, k, v))
+        scores = np.einsum("bhsd,bhtd->bhst", qa, ka) / np.sqrt(D)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhst,bhtd->bhsd", p, va)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want, atol=1e-4)
