@@ -59,7 +59,10 @@ from .ops.logic import is_tensor
 from . import (  # noqa: F401
     nn, optimizer, amp, io, jit, vision, metric, distributed, autograd,
     framework, profiler, incubate, hapi, static, text, utils, inference,
+    distribution, fft, signal, regularizer, hub, version,
 )
+
+__version__ = version.full_version
 
 from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401

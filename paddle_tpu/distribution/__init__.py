@@ -1,0 +1,27 @@
+"""paddle.distribution — probability distributions, transforms, and KL
+(reference `python/paddle/distribution/__init__.py`)."""
+from . import transform
+from .beta import Beta
+from .categorical import Categorical
+from .dirichlet import Dirichlet
+from .distribution import Distribution
+from .exponential_family import ExponentialFamily
+from .independent import Independent
+from .kl import kl_divergence, register_kl
+from .multinomial import Multinomial
+from .normal import Normal
+from .transform import (  # noqa: F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+from .transformed_distribution import TransformedDistribution
+from .uniform import Uniform
+
+__all__ = [
+    'Beta', 'Categorical', 'Dirichlet', 'Distribution', 'ExponentialFamily',
+    'Multinomial', 'Normal', 'Uniform', 'kl_divergence', 'register_kl',
+    'Independent', 'TransformedDistribution',
+]
+__all__.extend(transform.__all__)

@@ -1,0 +1,71 @@
+"""Beta distribution (reference `python/paddle/distribution/beta.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma
+
+from ..core.rng import next_key
+from ..ops._helpers import op
+from .distribution import _param
+from .exponential_family import ExponentialFamily
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        batch = jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return op("beta_mean", lambda a, b: a / (a + b),
+                  [self.alpha, self.beta])
+
+    @property
+    def variance(self):
+        def _var(a, b):
+            s = a + b
+            return a * b / (s * s * (s + 1))
+
+        return op("beta_variance", _var, [self.alpha, self.beta])
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(tuple(shape))
+        key = next_key()
+
+        def _sample(a, b):
+            return jax.random.beta(key, a, b, shape=shp or None)
+
+        return op("beta_sample", _sample, [self.alpha, self.beta])
+
+    def entropy(self):
+        def _ent(a, b):
+            s = a + b
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b) + (s - 2) * digamma(s))
+
+        return op("beta_entropy", _ent, [self.alpha, self.beta])
+
+    def log_prob(self, value):
+        value = _param(value)
+
+        def _lp(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - betaln(a, b))
+
+        return op("beta_log_prob", _lp, [value, self.alpha, self.beta])
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return op("beta_prob", jnp.exp, [lp])
+
+    @property
+    def _natural_parameters(self):
+        # p(x) = exp((a-1)log x + (b-1)log(1-x) - ln B(a,b))
+        return (op("beta_natural", lambda a: a - 1.0, [self.alpha]),
+                op("beta_natural", lambda b: b - 1.0, [self.beta]))
+
+    def _log_normalizer(self, x, y):
+        return betaln(x + 1.0, y + 1.0)

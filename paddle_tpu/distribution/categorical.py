@@ -1,0 +1,76 @@
+"""Categorical distribution (reference
+`python/paddle/distribution/categorical.py:32`).
+
+Follows the reference semantics: `logits` are unnormalized log-probabilities
+(KL/entropy normalize with a log-sum-exp, `categorical.py:213-228`); `probs`
+selects per-category probabilities by index; `sample` draws indices."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import next_key
+from ..ops._helpers import op, unwrap, wrap
+from .distribution import Distribution, _param
+
+
+def _log_softmax(z):
+    z = z - jnp.max(z, axis=-1, keepdims=True)
+    return z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _param(logits)
+        self.name = name or "Categorical"
+        super().__init__(batch_shape=tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        shp = tuple(shape)
+        key = next_key()
+        z = unwrap(self.logits)
+        # jax.random.categorical samples over the last axis; prepend the
+        # sample shape like the reference (sample index dims first).
+        out = jax.random.categorical(key, _log_softmax(z),
+                                     shape=shp + z.shape[:-1])
+        return wrap(out)
+
+    def entropy(self):
+        def _ent(z):
+            lp = _log_softmax(z)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+        return op("categorical_entropy", _ent, [self.logits])
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Categorical)
+
+        def _kl(z0, z1):
+            lp0 = _log_softmax(z0)
+            lp1 = _log_softmax(z1)
+            return jnp.sum(jnp.exp(lp0) * (lp0 - lp1), axis=-1,
+                           keepdims=True)
+
+        return op("categorical_kl", _kl, [self.logits, other.logits])
+
+    def probs(self, value):
+        idx = unwrap(_param(value)).astype(jnp.int32)
+
+        def _simple(z):
+            p = jnp.exp(_log_softmax(z))
+            if p.ndim == 1:
+                return p[idx]
+            return jnp.take_along_axis(p, idx, axis=-1)
+
+        return op("categorical_probs", _simple, [self.logits])
+
+    def log_prob(self, value):
+        idx = unwrap(_param(value)).astype(jnp.int32)
+
+        def _lp(z):
+            lp = _log_softmax(z)
+            if lp.ndim == 1:
+                return lp[idx]
+            return jnp.take_along_axis(lp, idx, axis=-1)
+
+        return op("categorical_log_prob", _lp, [self.logits])

@@ -1,0 +1,76 @@
+"""Dirichlet distribution (reference
+`python/paddle/distribution/dirichlet.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, digamma
+
+from ..core.rng import next_key
+from ..ops._helpers import op
+from .distribution import _param
+from .exponential_family import ExponentialFamily
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _param(concentration)
+        if len(self.concentration.shape) < 1:
+            raise ValueError(
+                "concentration must be at least 1-dimensional")
+        super().__init__(
+            batch_shape=tuple(self.concentration.shape[:-1]),
+            event_shape=tuple(self.concentration.shape[-1:]))
+
+    @property
+    def mean(self):
+        return op("dirichlet_mean",
+                  lambda c: c / jnp.sum(c, axis=-1, keepdims=True),
+                  [self.concentration])
+
+    @property
+    def variance(self):
+        def _var(c):
+            c0 = jnp.sum(c, axis=-1, keepdims=True)
+            return c * (c0 - c) / (c0 * c0 * (c0 + 1))
+
+        return op("dirichlet_variance", _var, [self.concentration])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        key = next_key()
+
+        def _sample(c):
+            return jax.random.dirichlet(key, c, shape=shp or None)
+
+        return op("dirichlet_sample", _sample, [self.concentration])
+
+    def entropy(self):
+        def _ent(c):
+            k = c.shape[-1]
+            c0 = jnp.sum(c, axis=-1)
+            lnB = jnp.sum(gammaln(c), axis=-1) - gammaln(c0)
+            return (lnB + (c0 - k) * digamma(c0)
+                    - jnp.sum((c - 1) * digamma(c), axis=-1))
+
+        return op("dirichlet_entropy", _ent, [self.concentration])
+
+    def log_prob(self, value):
+        value = _param(value)
+
+        def _lp(v, c):
+            lnB = jnp.sum(gammaln(c), axis=-1) - gammaln(
+                jnp.sum(c, axis=-1))
+            return jnp.sum((c - 1) * jnp.log(v), axis=-1) - lnB
+
+        return op("dirichlet_log_prob", _lp, [value, self.concentration])
+
+    @property
+    def _natural_parameters(self):
+        # p(x) = exp(<alpha-1, log x> - ln B(alpha)): theta = alpha - 1
+        return (op("dirichlet_natural", lambda c: c - 1.0,
+                   [self.concentration]),)
+
+    def _log_normalizer(self, x):
+        a = x + 1.0
+        return jnp.sum(gammaln(a), axis=-1) - gammaln(jnp.sum(a, axis=-1))

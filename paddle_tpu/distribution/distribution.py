@@ -1,0 +1,102 @@
+"""Probability distribution base class.
+
+API parity with the reference `python/paddle/distribution/distribution.py:40`
+(batch_shape/event_shape properties, sample/rsample/entropy/kl_divergence/
+prob/log_prob/probs surface).  TPU-native: parameters are stored as jax
+arrays behind the Tensor facade, all math is traced through the dispatch
+tape so log_prob/entropy are differentiable, and sampling consumes the
+global functional RNG key (`core.rng.next_key`) so it is reproducible under
+`paddle.seed` and usable inside `to_static` programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ..ops._helpers import op, unwrap, wrap
+
+
+def _param(x, dtype=None):
+    """Convert a scalar/list/ndarray/Tensor parameter to a float Tensor."""
+    if isinstance(x, Tensor):
+        if not np.issubdtype(np.dtype(x.dtype), np.floating):
+            return wrap(unwrap(x).astype(dtype_mod.get_default_dtype()))
+        return x
+    arr = np.asarray(x)
+    if not np.issubdtype(arr.dtype, np.floating):
+        arr = arr.astype(dtype or dtype_mod.get_default_dtype())
+    return wrap(jnp.asarray(arr))
+
+
+class Distribution:
+    """Abstract base class for probability distributions
+    (reference `distribution.py:40`)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(
+            batch_shape.shape if isinstance(batch_shape, Tensor)
+            else batch_shape)
+        self._event_shape = tuple(
+            event_shape.shape if isinstance(event_shape, Tensor)
+            else event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        """exp(log_prob(value)) unless a subclass has a closed form."""
+        lp = self.log_prob(_param(value))
+        return op("dist_prob", jnp.exp, [lp])
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self.batch_shape + self.event_shape
+
+    # helpers shared by subclasses -------------------------------------
+    @staticmethod
+    def _probs_to_logits(probs, is_binary=False):
+        p = unwrap(probs)
+        out = jnp.log(p / (1.0 - p)) if is_binary else jnp.log(p)
+        return wrap(out)
+
+    @staticmethod
+    def _logits_to_probs(logits, is_binary=False):
+        z = unwrap(logits)
+        if is_binary:
+            return wrap(1.0 / (1.0 + jnp.exp(-z)))
+        return wrap(jnp.exp(z - jnp.max(z, axis=-1, keepdims=True))
+                    / jnp.sum(jnp.exp(z - jnp.max(z, axis=-1, keepdims=True)),
+                              axis=-1, keepdims=True))

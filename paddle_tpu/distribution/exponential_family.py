@@ -1,0 +1,45 @@
+"""Exponential-family base with Bregman-divergence entropy (reference
+`python/paddle/distribution/exponential_family.py`).
+
+entropy = -F(theta) + <theta, grad F(theta)> - E[log h(x)] where F is the
+log normalizer; on TPU the gradient term is `jax.grad` of the log
+normalizer (the reference differentiates a static program for the same
+quantity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import op
+from .distribution import Distribution
+
+
+class ExponentialFamily(Distribution):
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_parameters):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """H = F(theta) - <theta, grad F(theta)> - E[log h(x)]."""
+        nparams = list(self._natural_parameters)
+
+        def _entropy(*theta):
+            f = lambda *t: jnp.sum(self._log_normalizer(*t))
+            grads = jax.grad(f, argnums=tuple(range(len(theta))))(*theta)
+            result = self._log_normalizer(*theta) - \
+                self._mean_carrier_measure
+            for t, g in zip(theta, grads):
+                term = t * g
+                if term.shape != result.shape:
+                    term = jnp.sum(term, axis=-1)
+                result = result - term
+            return result
+
+        return op("expfamily_entropy", _entropy, nparams)

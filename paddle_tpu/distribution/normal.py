@@ -1,0 +1,92 @@
+"""Normal distribution (reference `python/paddle/distribution/normal.py:30`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import next_key
+from ..ops._helpers import op, unwrap, wrap
+from .distribution import Distribution, _param
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        self.name = name or "Normal"
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return op("normal_mean", lambda l, s: jnp.broadcast_to(
+            l, jnp.broadcast_shapes(l.shape, s.shape)),
+            [self.loc, self.scale])
+
+    @property
+    def variance(self):
+        return op("normal_variance", lambda l, s: jnp.broadcast_to(
+            s * s, jnp.broadcast_shapes(l.shape, s.shape)),
+            [self.loc, self.scale])
+
+    @property
+    def stddev(self):
+        return op("normal_stddev", lambda l, s: jnp.broadcast_to(
+            s, jnp.broadcast_shapes(l.shape, s.shape)),
+            [self.loc, self.scale])
+
+    def sample(self, shape=(), seed=0):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(tuple(shape))
+        key = next_key()
+
+        def _sample(l, s):
+            eps = jax.random.normal(key, shp, dtype=jnp.result_type(l))
+            return l + s * eps
+
+        return op("normal_rsample", _sample, [self.loc, self.scale])
+
+    def entropy(self):
+        def _ent(l, s):
+            b = jnp.broadcast_shapes(l.shape, s.shape)
+            return jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), b)
+
+        return op("normal_entropy", _ent, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        value = _param(value)
+
+        def _lp(v, l, s):
+            var = s * s
+            return (-((v - l) ** 2) / (2 * var) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi))
+
+        return op("normal_log_prob", _lp, [value, self.loc, self.scale])
+
+    def probs(self, value):
+        value = _param(value)
+
+        def _p(v, l, s):
+            var = s * s
+            return jnp.exp(-((v - l) ** 2) / (2 * var)) / jnp.sqrt(
+                2 * math.pi * var)
+
+        return op("normal_probs", _p, [value, self.loc, self.scale])
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Normal)
+
+        def _kl(l0, s0, l1, s1):
+            ratio = s0 / s1
+            diff = (l0 - l1) / s1
+            return 0.5 * (ratio * ratio + diff * diff) - 0.5 - jnp.log(ratio)
+
+        return op("normal_kl", _kl,
+                  [self.loc, self.scale, other.loc, other.scale])

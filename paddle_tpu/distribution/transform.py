@@ -1,0 +1,441 @@
+"""Bijective/injective tensor transforms (reference
+`python/paddle/distribution/transform.py`).
+
+Each transform exposes forward/inverse, the log-det-Jacobian of both
+directions, and shape propagation; `TransformedDistribution` composes them
+with a base distribution.  All math runs through the dispatch tape (taped
+jnp ops) so transformed log_probs are differentiable."""
+from __future__ import annotations
+
+import enum
+import functools
+import operator
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import op, unwrap, wrap
+from .distribution import _param
+
+__all__ = [
+    'Transform', 'AbsTransform', 'AffineTransform', 'ChainTransform',
+    'ExpTransform', 'IndependentTransform', 'PowerTransform',
+    'ReshapeTransform', 'SigmoidTransform', 'SoftmaxTransform',
+    'StackTransform', 'StickBreakingTransform', 'TanhTransform',
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = 'bijection'
+    INJECTION = 'injection'
+    SURJECTION = 'surjection'
+    OTHER = 'other'
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.OTHER
+
+    # event dims consumed/produced (0 = elementwise)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, x):
+        if isinstance(x, Transform):
+            return ChainTransform([x, self])
+        return self.forward(x)
+
+    def forward(self, x):
+        return op(type(self).__name__ + "_fwd", self._forward, [_param(x)])
+
+    def inverse(self, y):
+        return op(type(self).__name__ + "_inv", self._inverse, [_param(y)])
+
+    def forward_log_det_jacobian(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return op(type(self).__name__ + "_fldj",
+                      self._forward_log_det_jacobian, [_param(x)])
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            y = self.forward(x)
+            return op(type(self).__name__ + "_fldj_via_inv",
+                      lambda v: -self._inverse_log_det_jacobian(v), [y])
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return op(type(self).__name__ + "_ildj",
+                      self._inverse_log_det_jacobian, [_param(y)])
+        # negate the forward log-det at the preimage (works for subclasses
+        # that override the *public* forward_log_det_jacobian too)
+        x = self.inverse(y)
+        ldj = self.forward_log_det_jacobian(x)
+        return op(type(self).__name__ + "_ildj_neg",
+                  lambda v: -v, [ldj])
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+
+class AbsTransform(Transform):
+    """y = |x| — surjective onto [0, inf); inverse returns the positive
+    preimage like the reference."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+
+    def forward(self, x):
+        return op("AffineTransform_fwd",
+                  lambda v, l, s: l + s * v,
+                  [_param(x), self.loc, self.scale])
+
+    def inverse(self, y):
+        return op("AffineTransform_inv",
+                  lambda v, l, s: (v - l) / s,
+                  [_param(y), self.loc, self.scale])
+
+    def forward_log_det_jacobian(self, x):
+        return op("AffineTransform_fldj",
+                  lambda v, s: jnp.broadcast_to(
+                      jnp.log(jnp.abs(s)),
+                      jnp.broadcast_shapes(v.shape, s.shape)),
+                  [_param(x), self.scale])
+
+    def inverse_log_det_jacobian(self, y):
+        return op("AffineTransform_ildj",
+                  lambda v, s: jnp.broadcast_to(
+                      -jnp.log(jnp.abs(s)),
+                      jnp.broadcast_shapes(v.shape, s.shape)),
+                  [_param(y), self.scale])
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on x > 0."""
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _param(power)
+
+    def forward(self, x):
+        return op("PowerTransform_fwd", lambda v, p: jnp.power(v, p),
+                  [_param(x), self.power])
+
+    def inverse(self, y):
+        return op("PowerTransform_inv", lambda v, p: jnp.power(v, 1.0 / p),
+                  [_param(y), self.power])
+
+    def forward_log_det_jacobian(self, x):
+        return op("PowerTransform_fldj",
+                  lambda v, p: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+                  [_param(x), self.power])
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return 1.0 / (1.0 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log sigmoid'(x) = -softplus(-x) - softplus(x)
+        sp = lambda v: jnp.logaddexp(v, 0.0)
+        return -sp(-x) - sp(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jnp.logaddexp(-2.0 * x, 0.0))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis — surjective onto the simplex."""
+    _type = Type.OTHER
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        z = x - jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(z)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> open simplex in R^K via stick breaking."""
+    _type = Type.BIJECTION
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = 1.0 / (1.0 + jnp.exp(-(x - jnp.log(offset))))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        ones = jnp.ones(x.shape[:-1] + (1,), dtype=x.dtype)
+        return jnp.concatenate([z, ones], axis=-1) * jnp.concatenate(
+            [ones, zc], axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        k = y_crop.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=y.dtype)
+        sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        sf = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), dtype=y.dtype), sf[..., :-1]],
+            axis=-1)
+        z = y_crop / sf
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        u = x - jnp.log(offset)
+        z = 1.0 / (1.0 + jnp.exp(-u))
+        # log prod z_i * (1-z)_cumulative
+        sp = lambda v: jnp.logaddexp(v, 0.0)
+        log_z = -sp(-u)
+        log_1mz_cum = jnp.cumsum(-sp(u), axis=-1)
+        shifted = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), dtype=x.dtype),
+             log_1mz_cum[..., :-1]], axis=-1)
+        return jnp.sum(log_z + shifted, axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(in_event_shape)
+        self._out = tuple(out_event_shape)
+        if functools.reduce(operator.mul, self._in, 1) != functools.reduce(
+                operator.mul, self._out, 1):
+            raise ValueError("event sizes must match")
+        self._domain_event_rank = len(self._in)
+        self._codomain_event_rank = len(self._out)
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self._in)]
+        return jnp.reshape(x, batch + self._out)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self._out)]
+        return jnp.reshape(y, batch + self._in)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self._in)]
+        return jnp.zeros(batch, dtype=x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self._in)
+        if tuple(shape[len(shape) - n:]) != self._in:
+            raise ValueError(f"shape {shape} does not end in {self._in}")
+        return tuple(shape[:len(shape) - n]) + self._out
+
+    def inverse_shape(self, shape):
+        n = len(self._out)
+        if tuple(shape[len(shape) - n:]) != self._out:
+            raise ValueError(f"shape {shape} does not end in {self._out}")
+        return tuple(shape[:len(shape) - n]) + self._in
+
+
+class IndependentTransform(Transform):
+    """Promote batch dims of a base transform to event dims (sums the
+    log-det over the reinterpreted dims)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+        self._domain_event_rank = base._domain_event_rank + self.rank
+        self._codomain_event_rank = base._codomain_event_rank + self.rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        return op("IndependentTransform_sum",
+                  lambda v: jnp.sum(
+                      v, axis=tuple(range(v.ndim - self.rank, v.ndim))),
+                  [ldj])
+
+    def inverse_log_det_jacobian(self, y):
+        ldj = self.base.inverse_log_det_jacobian(y)
+        return op("IndependentTransform_sum",
+                  lambda v: jnp.sum(
+                      v, axis=tuple(range(v.ndim - self.rank, v.ndim))),
+                  [ldj])
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)); log-dets accumulate."""
+
+    def __init__(self, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError("all elements must be Transforms")
+        self.transforms = list(transforms)
+        self._type = (Type.BIJECTION if all(
+            t._type == Type.BIJECTION for t in self.transforms)
+            else Type.OTHER if any(not t._is_injective()
+                                   for t in self.transforms)
+            else Type.INJECTION)
+        self._domain_event_rank = max(
+            (t._domain_event_rank for t in self.transforms), default=0)
+        self._codomain_event_rank = max(
+            (t._codomain_event_rank for t in self.transforms), default=0)
+
+    @classmethod
+    def _is_injective(cls):
+        return True  # instance-level check below
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else op(
+                "ChainTransform_add", lambda a, b: a + b, [total, ldj])
+            x = t.forward(x)
+        return total
+
+    def inverse_log_det_jacobian(self, y):
+        total = None
+        for t in reversed(self.transforms):
+            ldj = t.inverse_log_det_jacobian(y)
+            total = ldj if total is None else op(
+                "ChainTransform_add", lambda a, b: a + b, [total, ldj])
+            y = t.inverse(y)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError("all elements must be Transforms")
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+        self._type = (Type.BIJECTION if all(
+            t._type == Type.BIJECTION for t in self.transforms)
+            else Type.OTHER)
+
+    def _split(self, x):
+        x = _param(x)
+        n = len(self.transforms)
+        arr = unwrap(x)
+        return [wrap(a) for a in jnp.split(arr, n, axis=self.axis)]
+
+    def _stack(self, parts):
+        arrs = [unwrap(p) for p in parts]
+        return wrap(jnp.concatenate(arrs, axis=self.axis))
+
+    def forward(self, x):
+        return self._stack([t.forward(p)
+                            for t, p in zip(self.transforms, self._split(x))])
+
+    def inverse(self, y):
+        return self._stack([t.inverse(p)
+                            for t, p in zip(self.transforms, self._split(y))])
+
+    def forward_log_det_jacobian(self, x):
+        return self._stack([
+            t.forward_log_det_jacobian(p)
+            for t, p in zip(self.transforms, self._split(x))])

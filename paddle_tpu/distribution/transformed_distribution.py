@@ -1,0 +1,76 @@
+"""TransformedDistribution (reference
+`python/paddle/distribution/transformed_distribution.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops._helpers import op
+from .distribution import Distribution
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if not isinstance(base, Distribution):
+            raise TypeError("base must be a Distribution")
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError("all transforms must be Transform instances")
+        self._base = base
+        self._transforms = list(transforms)
+        chain = ChainTransform(self._transforms)
+        base_shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(base_shape)
+        event_rank = max(chain._codomain_event_rank, len(base.event_shape))
+        cut = len(out_shape) - event_rank
+        super().__init__(batch_shape=tuple(out_shape[:cut]),
+                         event_shape=tuple(out_shape[cut:]))
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        """log p(y) = log p_base(x) - sum log|det J_t(x)| with x = t^-1(y),
+        event dims of each transform summed out."""
+        log_prob = None
+        y = value
+        event_rank = len(self.event_shape)
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            extra = event_rank - t._codomain_event_rank
+
+            def _sum_rightmost(e, n=extra):
+                if n <= 0:
+                    return e
+                return jnp.sum(e, axis=tuple(range(e.ndim - n, e.ndim)))
+
+            term = op("transformed_ldj_sum", _sum_rightmost, [ldj])
+            log_prob = term if log_prob is None else op(
+                "transformed_add", lambda a, b: a + b, [log_prob, term])
+            y = x
+            event_rank = t._domain_event_rank + max(
+                event_rank - t._codomain_event_rank, 0)
+        base_lp = self._base.log_prob(y)
+        extra_base = event_rank - len(self._base.event_shape)
+        if extra_base > 0:
+            base_lp = op(
+                "transformed_base_sum",
+                lambda e: jnp.sum(
+                    e, axis=tuple(range(e.ndim - extra_base, e.ndim))),
+                [base_lp])
+        if log_prob is None:
+            return base_lp
+        return op("transformed_log_prob",
+                  lambda b, l: b - l, [base_lp, log_prob])

@@ -1,0 +1,77 @@
+"""Uniform distribution (reference `python/paddle/distribution/uniform.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import next_key
+from ..ops._helpers import op
+from .distribution import Distribution, _param
+
+
+class Uniform(Distribution):
+    """U(low, high) on [low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        self.name = name or "Uniform"
+        batch = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return op("uniform_mean", lambda a, b: (a + b) / 2,
+                  [self.low, self.high])
+
+    @property
+    def variance(self):
+        return op("uniform_variance", lambda a, b: (b - a) ** 2 / 12,
+                  [self.low, self.high])
+
+    def sample(self, shape=(), seed=0):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(tuple(shape))
+        key = next_key()
+
+        def _sample(a, b):
+            u = jax.random.uniform(key, shp, dtype=jnp.result_type(a))
+            return a + (b - a) * u
+
+        return op("uniform_rsample", _sample, [self.low, self.high])
+
+    def entropy(self):
+        return op("uniform_entropy", lambda a, b: jnp.log(b - a),
+                  [self.low, self.high])
+
+    def log_prob(self, value):
+        value = _param(value)
+
+        def _lp(v, a, b):
+            inside = jnp.logical_and(v >= a, v < b)
+            lp = -jnp.log(b - a)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return op("uniform_log_prob", _lp, [value, self.low, self.high])
+
+    def probs(self, value):
+        value = _param(value)
+
+        def _p(v, a, b):
+            inside = jnp.logical_and(v >= a, v < b)
+            return jnp.where(inside, 1.0 / (b - a), 0.0)
+
+        return op("uniform_probs", _p, [value, self.low, self.high])
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Uniform)
+
+        def _kl(a0, b0, a1, b1):
+            return jnp.log((b1 - a1) / (b0 - a0))
+
+        return op("uniform_kl", _kl,
+                  [self.low, self.high, other.low, other.high])

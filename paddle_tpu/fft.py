@@ -1,0 +1,222 @@
+"""paddle.fft — discrete Fourier transform API (reference
+`python/paddle/fft.py`, 22 public functions).
+
+TPU-native: thin taped wrappers over `jnp.fft` (XLA lowers FFT natively);
+the Hermitian n-d variants (`hfft2/hfftn/ihfft2/ihfftn`), which the
+reference implements with a dedicated `fft_c2r`/`fft_r2c` kernel pair
+(`paddle/fluid/operators/spectral_op.cc`), are built here from the
+mathematical definition: forward FFT of the Hermitian extension along the
+last transform axis / conjugated one-sided inverse.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core import dtype as dtype_mod
+from .ops._helpers import op, unwrap, wrap
+
+__all__ = [
+    'fft', 'ifft', 'rfft', 'irfft', 'hfft', 'ihfft',
+    'fft2', 'ifft2', 'rfft2', 'irfft2', 'hfft2', 'ihfft2',
+    'fftn', 'ifftn', 'rfftn', 'irfftn', 'hfftn', 'ihfftn',
+    'fftfreq', 'rfftfreq', 'fftshift', 'ifftshift',
+]
+
+_NORMS = ("forward", "backward", "ortho")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm}. Norm should be forward, backward "
+            "or ortho")
+
+
+def _axes2(x, s, axes):
+    if s is not None and len(s) != 2:
+        raise ValueError(f"Invalid FFT argument s ({s}), it should be a "
+                         "sequence of 2 integers.")
+    if axes is not None and len(axes) != 2:
+        raise ValueError(f"Invalid FFT argument axes ({axes}), it should "
+                         "be a sequence of 2 integers.")
+    return s, axes
+
+
+def _to_complex(a):
+    if not jnp.issubdtype(a.dtype, jnp.complexfloating):
+        return a.astype(jnp.complex64)
+    return a
+
+
+# ---------------------------------------------------------------- 1-D
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return op("fft", lambda a: jnp.fft.fft(_to_complex(a), n=n, axis=axis,
+                                           norm=norm), [x])
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return op("ifft", lambda a: jnp.fft.ifft(_to_complex(a), n=n, axis=axis,
+                                             norm=norm), [x])
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return op("rfft", lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=norm),
+              [x])
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return op("irfft", lambda a: jnp.fft.irfft(_to_complex(a), n=n,
+                                               axis=axis, norm=norm), [x])
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return op("hfft", lambda a: jnp.fft.hfft(_to_complex(a), n=n, axis=axis,
+                                             norm=norm), [x])
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return op("ihfft", lambda a: jnp.fft.ihfft(a, n=n, axis=axis,
+                                               norm=norm), [x])
+
+
+# ---------------------------------------------------------------- 2-D
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    s, axes = _axes2(x, s, axes)
+    return fftn(x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    s, axes = _axes2(x, s, axes)
+    return ifftn(x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    s, axes = _axes2(x, s, axes)
+    return rfftn(x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    s, axes = _axes2(x, s, axes)
+    return irfftn(x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    s, axes = _axes2(x, s, axes)
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    s, axes = _axes2(x, s, axes)
+    return ihfftn(x, s, axes, norm)
+
+
+# ---------------------------------------------------------------- N-D
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return op("fftn", lambda a: jnp.fft.fftn(_to_complex(a), s=s, axes=axes,
+                                             norm=norm), [x])
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return op("ifftn", lambda a: jnp.fft.ifftn(_to_complex(a), s=s,
+                                               axes=axes, norm=norm), [x])
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return op("rfftn", lambda a: jnp.fft.rfftn(a, s=s, axes=axes,
+                                               norm=norm), [x])
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return op("irfftn", lambda a: jnp.fft.irfftn(_to_complex(a), s=s,
+                                                 axes=axes, norm=norm), [x])
+
+
+def _hermitian_extend(a, n, axis):
+    """Rebuild the full length-n spectrum from the one-sided Hermitian
+    half along `axis` (inverse of taking [..., :n//2+1])."""
+    a = jnp.moveaxis(a, axis, -1)
+    m = n // 2 + 1
+    if a.shape[-1] < m:
+        pad = [(0, 0)] * (a.ndim - 1) + [(0, m - a.shape[-1])]
+        a = jnp.pad(a, pad)
+    else:
+        a = a[..., :m]
+    # interior bins mirrored with conjugation: index n-k for k in [m, n)
+    k = np.arange(1, n - m + 1)[::-1]      # m-1-offset interior, reversed
+    tail = jnp.conj(a[..., k])
+    full = jnp.concatenate([a, tail], axis=-1)
+    return jnp.moveaxis(full, -1, axis)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Real-output FFT of a signal with Hermitian symmetry along the last
+    transform axis (n-d generalization of `hfft`)."""
+    _check_norm(norm)
+
+    def _primal(a):
+        a = _to_complex(a)
+        if axes is not None:
+            ax = [ax_ % a.ndim for ax_ in axes]
+        elif s is not None:
+            # numpy semantics: s with axes=None means the last len(s) axes
+            ax = list(range(a.ndim - len(s), a.ndim))
+        else:
+            ax = list(range(a.ndim))
+        last = ax[-1]
+        n_last = s[-1] if s is not None else 2 * (a.shape[last] - 1)
+        if n_last < 1:
+            raise ValueError("output length on the Hermitian axis must "
+                             "be >= 1")
+        full = _hermitian_extend(a, n_last, last)
+        sizes = None
+        if s is not None:
+            sizes = list(s[:-1]) + [n_last]
+        out = jnp.fft.fftn(full, s=sizes, axes=ax, norm=norm)
+        return jnp.real(out)
+
+    return op("hfftn", _primal, [x])
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """One-sided inverse of `hfftn`: conj(rfftn(x)) with inverse-direction
+    normalization (matches `np.fft.ihfft` on each last-axis line)."""
+    _check_norm(norm)
+    inv = {"backward": "forward", "forward": "backward",
+           "ortho": "ortho"}[norm]
+
+    def _primal(a):
+        return jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes, norm=inv))
+
+    return op("ihfftn", _primal, [x])
+
+
+# ---------------------------------------------------------------- helpers
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype else \
+        dtype_mod.get_default_dtype()
+    return wrap(jnp.fft.fftfreq(int(n), d=float(d)).astype(dt))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype else \
+        dtype_mod.get_default_dtype()
+    return wrap(jnp.fft.rfftfreq(int(n), d=float(d)).astype(dt))
+
+
+def fftshift(x, axes=None, name=None):
+    return op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), [x])
+
+
+def ifftshift(x, axes=None, name=None):
+    return op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), [x])

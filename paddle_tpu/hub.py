@@ -1,0 +1,64 @@
+"""paddle.hub — load models/entrypoints from a local hubconf.py (reference
+`python/paddle/hub.py` → `python/paddle/hapi/hub.py`).
+
+TPU build: the local-dir source is fully supported; github/gitee sources
+need network egress and raise a clear error instead (this environment is
+air-gapped, and the reference's download path is just a fetch in front of
+the same hubconf protocol)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ['list', 'help', 'load']
+
+_HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.pop("paddle_tpu_hubconf", None)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected local/github/gitee")
+    if source != "local":
+        raise RuntimeError(
+            "github/gitee hub sources need network access, which this "
+            "TPU build does not have; clone the repo and use "
+            "source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """List callable entrypoints defined by repo_dir/hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of an entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"entrypoint {model!r} not found in hubconf")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call an entrypoint and return its result (usually a Layer)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"entrypoint {model!r} not found in hubconf")
+    return getattr(mod, model)(**kwargs)

@@ -19,8 +19,7 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
     """Batched diagonal embedding (reference: functional/extension
     diag_embed)."""
     return op("diag_embed",
-              lambda a: jnp.apply_along_axis(jnp.diag, -1, a) if False else
-              _diag_embed_impl(a, offset, dim1, dim2), [input])
+              lambda a: _diag_embed_impl(a, offset, dim1, dim2), [input])
 
 
 def _diag_embed_impl(a, offset, dim1, dim2):
@@ -149,14 +148,31 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   name=None):
     """Hierarchical sigmoid over the default complete binary tree
     (reference: loss.py hsigmoid_loss → phi hierarchical_sigmoid kernel).
-    Custom trees (path_table/path_code) follow the same bit walk."""
+    Custom trees: pass path_table [N, L] (internal-node ids, negative =
+    padding) + path_code [N, L] (0/1 branch bits), matching the reference's
+    is_custom path."""
     code_len = int(np.ceil(np.log2(max(num_classes, 2))))
+    if (path_table is None) != (path_code is None):
+        raise ValueError("path_table and path_code must be given together")
 
     def _primal(x, lbl, w, *rest):
         i = 0
         b = None
         if bias is not None:
             b = rest[i]; i += 1
+        if path_table is not None:
+            ptab = rest[i].astype(jnp.int32); i += 1
+            pcode = rest[i].astype(jnp.float32); i += 1
+            valid = ptab >= 0                              # [N, L]
+            nid = jnp.clip(ptab, 0, w.shape[0] - 1)
+            logits = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
+                                w[nid])                    # [N, L]
+            if b is not None:
+                logits = logits + b.reshape(-1)[nid]
+            lo = jnp.maximum(logits, 0) - logits * pcode + \
+                jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            return jnp.sum(jnp.where(valid, lo, 0.0), axis=1,
+                           keepdims=True)
         lbl = lbl.reshape(-1).astype(jnp.int32)
         # default tree: internal node ids via the heap walk of (label +
         # num_classes), matching the phi default-tree construction
@@ -178,6 +194,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         return losses[:, None]
 
     args = [input, label, weight] + ([bias] if bias is not None else [])
+    if path_table is not None:
+        args += [path_table, path_code]
     return op("hsigmoid_loss", _primal, args)
 
 
@@ -224,13 +242,20 @@ def sparse_attention(query, key, value, sparse_csr_offset,
 
     def _primal(q, k, v, offs, cols):
         B, H, S, D = q.shape
+        offs2 = offs.reshape(B, H, -1)
+        cols2 = cols.reshape(B, H, -1)
+        nnz = cols2.shape[-1]
+
+        # per-(b,h) row ids from that head's own CSR offsets
+        def _rows(o):
+            return jnp.repeat(jnp.arange(S), jnp.diff(o),
+                              total_repeat_length=nnz)
+
+        row_ids = jax.vmap(jax.vmap(_rows))(offs2)       # [B, H, nnz]
         mask = jnp.zeros((B, H, S, S), bool)
-        row_ids = jnp.repeat(
-            jnp.arange(S), jnp.diff(offs.reshape(B, H, -1)[0, 0]),
-            total_repeat_length=cols.shape[-1])
         mask = mask.at[
             jnp.arange(B)[:, None, None], jnp.arange(H)[None, :, None],
-            row_ids[None, None, :], cols.reshape(B, H, -1)].set(True)
+            row_ids, cols2].set(True)
         scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(D)
         scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
         probs = jax.nn.softmax(scores, axis=-1)

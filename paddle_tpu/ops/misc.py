@@ -183,3 +183,50 @@ def unstack(x, axis=0, num=None, name=None):
     return op("unstack",
               lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
               [x], n_outs=n)
+
+
+# -- complex-number surface (reference: python/paddle/tensor/attribute.py
+# real/imag, math.py conj/angle, manipulation as_complex/as_real) ---------
+
+def real(x, name=None):
+    """Real part of a complex tensor (identity view on real input)."""
+    return op("real", jnp.real, [x])
+
+
+def imag(x, name=None):
+    """Imaginary part of a complex tensor."""
+    return op("imag", jnp.imag, [x])
+
+
+def conj(x, name=None):
+    """Elementwise complex conjugate (identity on real input)."""
+    return op("conj", jnp.conj, [x])
+
+
+def angle(x, name=None):
+    """Elementwise argument (phase angle) in radians."""
+    return op("angle", jnp.angle, [x])
+
+
+def as_complex(x, name=None):
+    """View the last size-2 axis of a real tensor as complex:
+    [..., 2] float -> [...] complex."""
+
+    def _primal(a):
+        if a.shape[-1] != 2:
+            raise ValueError("as_complex needs a trailing axis of size 2")
+        return jax.lax.complex(a[..., 0], a[..., 1])
+
+    return op("as_complex", _primal, [x])
+
+
+def as_real(x, name=None):
+    """Inverse of as_complex: [...] complex -> [..., 2] float."""
+
+    def _primal(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+
+    return op("as_real", _primal, [x])
+
+
+__all__ += ["real", "imag", "conj", "angle", "as_complex", "as_real"]

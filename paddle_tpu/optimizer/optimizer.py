@@ -27,13 +27,16 @@ class Optimizer:
         self._parameter_list = list(parameters) if parameters is not None else None
         self._grad_clip = grad_clip
         self._name = name
+        self._regularizer = None
         if isinstance(weight_decay, float) or isinstance(weight_decay, int):
             self._weight_decay = float(weight_decay)
         elif weight_decay is None:
             self._weight_decay = None
-        else:  # L2Decay-like object with a coeff
+        else:  # paddle.regularizer.L1Decay/L2Decay (or coeff-duck-typed)
             self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(
                 weight_decay, "coeff", 0.0)))
+            if hasattr(weight_decay, "_grad_term"):
+                self._regularizer = weight_decay
         # name → {acc_name: Tensor}
         self._accumulators: Dict[str, Dict[str, Tensor]] = {}
         self._acc_inits: Dict[tuple, float] = {}
@@ -144,9 +147,13 @@ class Optimizer:
         raise NotImplementedError
 
     def _decayed_grad(self, p, g):
-        """L2 regularization folded into the gradient (reference: coupled
-        weight decay for SGD/Momentum family)."""
-        if self._weight_decay:
+        """Regularization folded into the gradient (reference: coupled
+        weight decay for SGD/Momentum family). L1/L2 shape comes from the
+        paddle.regularizer object when one was passed."""
+        if self._regularizer is not None:
+            g = g + self._regularizer._grad_term(
+                p._value()).astype(g.dtype)
+        elif self._weight_decay:
             g = g + self._weight_decay * p._value().astype(g.dtype)
         return g
 

@@ -1,0 +1,40 @@
+"""paddle.regularizer — L1Decay / L2Decay (reference
+`python/paddle/regularizer.py:20,82`).
+
+The optimizer folds the decay into the gradient before the update rule
+(coupled decay, matching the reference's regularizer-append pass); AdamW's
+decoupled decay is separate and wins over a regularizer when both are set,
+like the reference."""
+from __future__ import annotations
+
+__all__ = ['L1Decay', 'L2Decay']
+
+
+class WeightDecayRegularizer:
+    """Base class; subclasses define the gradient contribution."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __str__(self):
+        return f"{type(self).__name__}, coeff={self._coeff}"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|param|); grad += coeff * sign(param)."""
+
+    def _grad_term(self, p_arr):
+        import jax.numpy as jnp
+
+        return self._coeff * jnp.sign(p_arr)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(param^2); grad += coeff * param."""
+
+    def _grad_term(self, p_arr):
+        return self._coeff * p_arr

@@ -1,0 +1,186 @@
+"""paddle.signal — frame / overlap_add / stft / istft (reference
+`python/paddle/signal.py`).
+
+TPU-native: framing is a gather with a static index grid (XLA turns it
+into strided loads), overlap-add is a scatter-add, and stft/istft compose
+them with `paddle.fft` — no custom kernel needed (the reference routes
+through dedicated `frame`/`overlap_add` C++ ops)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import fft as _fft
+from .ops._helpers import op, unwrap, wrap
+from .core.tensor import Tensor
+
+__all__ = ['frame', 'overlap_add', 'stft', 'istft']
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames of `frame_length` every `hop_length`
+    samples along `axis` (last or first, like the reference)."""
+    frame_length = int(frame_length)
+    hop_length = int(hop_length)
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+
+    def _primal(a):
+        ax = axis % a.ndim if a.ndim else 0
+        if ax not in (0, a.ndim - 1):
+            raise ValueError("axis must be the first or last dimension")
+        n = a.shape[ax]
+        if frame_length > n:
+            raise ValueError(
+                f"frame_length ({frame_length}) > signal length ({n})")
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = np.arange(n_frames) * hop_length
+        idx = starts[:, None] + np.arange(frame_length)[None, :]
+        if ax == a.ndim - 1:
+            out = jnp.take(a, jnp.asarray(idx), axis=ax)      # [..., F, L]
+            return jnp.swapaxes(out, -1, -2)                  # [..., L, F]
+        out = jnp.take(a, jnp.asarray(idx), axis=0)           # [F, L, ...]
+        return jnp.swapaxes(out, 0, 1)                        # [L, F, ...]
+
+    return op("frame", _primal, [x])
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of `frame`: sum overlapping frames spaced `hop_length`
+    apart. Input [..., frame_length, n_frames] (axis=-1) or
+    [frame_length, n_frames, ...]-transposed layout (axis=0)."""
+    hop_length = int(hop_length)
+
+    def _primal(a):
+        if axis % a.ndim == 0:
+            # frame(axis=0) layout is [frame_length, n_frames, ...]:
+            # move L to -2 and F to -1 for _ola_last, then restore
+            a2 = jnp.moveaxis(a, (0, 1), (-2, -1))
+            out = _ola_last(a2)
+            return jnp.moveaxis(out, -1, 0)
+        return _ola_last(a)
+
+    def _ola_last(a):
+        L, F = a.shape[-2], a.shape[-1]
+        n = (F - 1) * hop_length + L
+        starts = np.arange(F) * hop_length
+        idx = (starts[None, :] + np.arange(L)[:, None]).reshape(-1)  # [L*F]
+        vals = a.reshape(a.shape[:-2] + (L * F,))
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        return out.at[..., jnp.asarray(idx)].add(vals)
+
+    return op("overlap_add", _primal, [x])
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode='reflect', normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference `signal.py:237`)."""
+    n_fft = int(n_fft)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    if window is not None:
+        w = unwrap(window) if isinstance(window, Tensor) else jnp.asarray(
+            window)
+        if w.shape != (win_length,):
+            raise ValueError("window must be 1-D of length win_length")
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    # center-pad the window to n_fft
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def _primal(a, wa):
+        if onesided and jnp.iscomplexobj(a):
+            raise ValueError(
+                "stft with complex input requires onesided=False "
+                "(matches the reference's check)")
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = np.arange(n_frames) * hop_length
+        idx = starts[:, None] + np.arange(n_fft)[None, :]
+        frames = jnp.take(a, jnp.asarray(idx), axis=-1)  # [..., F, n_fft]
+        frames = frames * wa.astype(frames.dtype)
+        if onesided and not jnp.iscomplexobj(a):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames.astype(
+                jnp.complex64 if frames.dtype != jnp.complex128
+                else jnp.complex128), axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        out = jnp.swapaxes(spec, -1, -2)   # [..., freq, frames]
+        if squeeze:
+            out = out[0]
+        return out
+
+    return op("stft", _primal, [x, wrap(w)])
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with the standard window-envelope normalization
+    (reference `signal.py:395`)."""
+    n_fft = int(n_fft)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    if window is not None:
+        w = unwrap(window) if isinstance(window, Tensor) else jnp.asarray(
+            window)
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def _primal(a, wa):
+        squeeze = a.ndim == 2
+        if squeeze:
+            a = a[None]
+        spec = jnp.swapaxes(a, -1, -2)     # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+            if not return_complex:
+                frames = jnp.real(frames)
+        frames = frames * wa.astype(frames.dtype)
+        F = frames.shape[-2]
+        n = (F - 1) * hop_length + n_fft
+        starts = np.arange(F) * hop_length
+        idx = (starts[:, None] + np.arange(n_fft)[None, :]).reshape(-1)
+        vals = frames.reshape(frames.shape[:-2] + (-1,))
+        sig = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        sig = sig.at[..., jnp.asarray(idx)].add(vals)
+        # window-envelope normalization
+        wsq = (wa * wa).astype(
+            frames.dtype if not jnp.iscomplexobj(frames) else jnp.float32)
+        env = jnp.zeros((n,), wsq.dtype)
+        env = env.at[jnp.asarray(idx)].add(
+            jnp.tile(wsq, F))
+        sig = sig / jnp.where(jnp.abs(env) > 1e-11, env, 1.0)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:n - pad]
+        if length is not None:
+            sig = sig[..., :length]
+            if sig.shape[-1] < length:
+                sig = jnp.pad(
+                    sig, [(0, 0)] * (sig.ndim - 1)
+                    + [(0, length - sig.shape[-1])])
+        if squeeze:
+            sig = sig[0]
+        return sig
+
+    return op("istft", _primal, [x, wrap(w)])

@@ -1,0 +1,33 @@
+"""paddle.version (reference: generated `python/paddle/version.py`).
+
+The reference generates this at build time from git state; here it records
+the framework version of this TPU-native build."""
+full_version = "0.3.0"
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+cuda_version = "False"    # parity field: this build has no CUDA
+cudnn_version = "False"
+istaged = True
+commit = "tpu-native"
+
+__all__ = ["cuda", "cudnn", "show"]
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"cuda: {cuda_version}")
+    print(f"cudnn: {cudnn_version}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,62 @@
+"""Profile the EXACT bench.py train step (fused-CE compute_loss path) on the
+real chip; prints the profiler statistic table so the top device-time sinks
+are visible without TensorBoard."""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def main(batch=8, seq=1024):
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.models import gpt2_345m, GPTForCausalLM
+    from paddle_tpu.distributed import fleet
+
+    strategy = paddle.distributed.DistributedStrategy()
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    cfg = gpt2_345m(recompute=False, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=1e-4,
+                               parameters=model.parameters()))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            loss = model.compute_loss(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
+    y = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
+    for _ in range(3):
+        loss = train_step(x, y)
+    float(loss)
+
+    p = profiler.Profiler(
+        scheduler=profiler.make_scheduler(closed=0, ready=1, record=3,
+                                          repeat=1),
+        on_trace_ready=profiler.export_chrome_tracing("/tmp/prof_bench"),
+        log_dir="/tmp/prof_bench")
+    p.start()
+    for _ in range(4):
+        loss = train_step(x, y)
+        float(loss)
+        p.step(num_samples=batch * seq)
+    p.stop()
+    p.summary(row_limit=40)
+
+
+if __name__ == "__main__":
+    kw = {}
+    for a in sys.argv[1:]:
+        k, v = a.split("=")
+        kw[k] = int(v)
+    main(**kw)
