@@ -95,3 +95,79 @@ def summary(net, input_size=None, dtypes=None, input=None):
     from .hapi.summary import summary as _summary
 
     return _summary(net, input_size, dtypes=dtypes, input=input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _flops
+
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)
+
+
+from . import sysconfig  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402
+
+
+# build-capability predicates (reference framework.py): this build targets
+# TPU via XLA — never CUDA/XPU/NPU binaries.
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def get_cudnn_version():
+    return None
+
+
+class _DtypeInfo:
+    def __init__(self, np_info):
+        self.min = float(np_info.min) if hasattr(np_info, "min") else None
+        self.max = float(np_info.max)
+        self.dtype = str(np_info.dtype)
+        if hasattr(np_info, "eps"):
+            self.eps = float(np_info.eps)
+            self.tiny = float(np_info.tiny)
+            self.smallest_normal = float(np_info.tiny)
+            self.resolution = float(np_info.resolution)
+        else:
+            self.bits = int(np_info.bits)
+
+
+def iinfo(dtype):
+    """Integer dtype limits (reference pybind iinfo)."""
+    import numpy as _np
+
+    info = _np.iinfo(_dtype_mod.convert_dtype(dtype))
+    out = _DtypeInfo(info)
+    out.min = int(info.min)
+    out.max = int(info.max)
+    out.bits = int(info.bits)
+    return out
+
+
+def finfo(dtype):
+    """Float dtype limits (reference pybind finfo)."""
+    import numpy as _np
+    import ml_dtypes as _mld  # jax dependency, provides bfloat16 finfo
+
+    dt = _dtype_mod.convert_dtype(dtype)
+    try:
+        info = _np.finfo(dt)
+    except (TypeError, ValueError):
+        info = _mld.finfo(dt)
+    return _DtypeInfo(info)

@@ -49,18 +49,24 @@ class Multinomial(Distribution):
         return wrap(counts)
 
     def entropy(self):
-        # exact entropy of the multinomial is intractable in closed form;
-        # the reference computes it by expanding the support only for small
-        # n; we use the standard sum over categorical entropy bound the
-        # reference tests accept: E = -sum_x p(x) log p(x) computed via the
-        # categorical decomposition.
+        """Exact entropy via the binomial marginals:
+        H = -log n! - n * sum_i p_i log p_i + sum_i E[log x_i!],
+        with E[log x_i!] = sum_k Binom(n,k) p_i^k (1-p_i)^{n-k} log k!
+        (x_i ~ Binomial(n, p_i); n is a static python int)."""
         n = self.total_count
 
         def _ent(p):
-            cat_ent = -jnp.sum(p * jnp.log(p), axis=-1)
-            # n! normalization term: log n! - sum E[log x_i!] approximated
-            # at the mean counts (matches reference tolerance for small n)
-            return n * cat_ent
+            k = jnp.arange(n + 1, dtype=p.dtype)               # [n+1]
+            log_binom = (gammaln(jnp.asarray(float(n + 1)))
+                         - gammaln(k + 1) - gammaln(n - k + 1))
+            pe = p[..., None]                                   # [..., K, 1]
+            log_pmf = (log_binom + k * jnp.log(pe)
+                       + (n - k) * jnp.log1p(-pe))              # [..., K, n+1]
+            e_log_fact = jnp.sum(jnp.exp(log_pmf) * gammaln(k + 1),
+                                 axis=-1)                       # [..., K]
+            return (-gammaln(jnp.asarray(float(n + 1)))
+                    - n * jnp.sum(p * jnp.log(p), axis=-1)
+                    + jnp.sum(e_log_fact, axis=-1))
 
         return op("multinomial_entropy", _ent, [self.probs])
 

@@ -43,9 +43,10 @@ class Transform:
     _domain_event_rank = 0
     _codomain_event_rank = 0
 
-    @classmethod
-    def _is_injective(cls):
-        return Type.is_injective(cls._type)
+    def _is_injective(self):
+        # instance method: composite transforms (Chain/Stack) compute their
+        # _type per-instance from their members
+        return Type.is_injective(self._type)
 
     def __call__(self, x):
         if isinstance(x, Transform):
@@ -360,10 +361,6 @@ class ChainTransform(Transform):
             (t._domain_event_rank for t in self.transforms), default=0)
         self._codomain_event_rank = max(
             (t._codomain_event_rank for t in self.transforms), default=0)
-
-    @classmethod
-    def _is_injective(cls):
-        return True  # instance-level check below
 
     def forward(self, x):
         for t in self.transforms:

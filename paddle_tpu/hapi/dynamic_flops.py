@@ -1,0 +1,117 @@
+"""paddle.flops — per-layer FLOP accounting via forward hooks (reference
+`python/paddle/hapi/dynamic_flops.py:25`).
+
+Counts multiply-accumulates as 1 FLOP (the reference's convention) for the
+standard layer set; `custom_ops` maps Layer subclasses to
+`fn(layer, input, output) -> flops` overrides."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn import layer_base
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _count_linear(layer, x, y):
+    return _numel(x.shape) // x.shape[-1] * int(layer.weight.shape[0]) \
+        * int(layer.weight.shape[1])
+
+
+def _count_conv(layer, x, y):
+    w = layer.weight
+    kernel = _numel(w.shape[1:])             # cin/groups * kh * kw
+    return _numel(y.shape) * kernel
+
+
+def _count_norm(layer, x, y):
+    return 2 * _numel(x.shape)
+
+
+def _count_act(layer, x, y):
+    return _numel(x.shape)
+
+
+def _count_pool(layer, x, y):
+    return _numel(y.shape)
+
+
+def _count_embedding(layer, x, y):
+    return 0
+
+
+def _default_table():
+    from .. import nn
+
+    table = {}
+    for name, fn in [
+        ("Linear", _count_linear),
+        ("Conv1D", _count_conv), ("Conv2D", _count_conv),
+        ("Conv3D", _count_conv),
+        ("Conv1DTranspose", _count_conv), ("Conv2DTranspose", _count_conv),
+        ("BatchNorm", _count_norm), ("BatchNorm1D", _count_norm),
+        ("BatchNorm2D", _count_norm), ("BatchNorm3D", _count_norm),
+        ("LayerNorm", _count_norm), ("GroupNorm", _count_norm),
+        ("ReLU", _count_act), ("GELU", _count_act), ("Sigmoid", _count_act),
+        ("Tanh", _count_act), ("Softmax", _count_act),
+        ("AvgPool1D", _count_pool), ("AvgPool2D", _count_pool),
+        ("AvgPool3D", _count_pool), ("MaxPool1D", _count_pool),
+        ("MaxPool2D", _count_pool), ("MaxPool3D", _count_pool),
+        ("AdaptiveAvgPool2D", _count_pool),
+        ("Embedding", _count_embedding),
+    ]:
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            table[cls] = fn
+    return table
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total forward FLOPs of `net` on a zero tensor of `input_size`."""
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    table = _default_table()
+    if custom_ops:
+        table.update(custom_ops)
+
+    counts = {}
+    handles = []
+
+    def make_hook(layer, fn):
+        def hook(lyr, inp, out):
+            x = inp[0] if isinstance(inp, (tuple, list)) else inp
+            y = out[0] if isinstance(out, (tuple, list)) else out
+            counts[id(lyr)] = counts.get(id(lyr), 0) + int(fn(lyr, x, y))
+
+        return hook
+
+    for lyr in net.sublayers(include_self=True):
+        fn = table.get(type(lyr))
+        if fn is not None:
+            handles.append(lyr.register_forward_post_hook(
+                make_hook(lyr, fn)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor._wrap(jnp.zeros(tuple(input_size), jnp.float32))
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(counts.values())
+    if print_detail:
+        for lyr in net.sublayers(include_self=True):
+            if id(lyr) in counts:
+                print(f"{type(lyr).__name__:24s} {counts[id(lyr)]:>14,d}")
+        print(f"{'Total':24s} {total:>14,d}")
+    return total

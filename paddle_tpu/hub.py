@@ -9,21 +9,24 @@ from __future__ import annotations
 
 import importlib.util
 import os
-import sys
 
 __all__ = ['list', 'help', 'load']
 
 _HUB_CONF = "hubconf.py"
+_cache = {}
 
 
-def _load_hubconf(repo_dir):
+def _load_hubconf(repo_dir, force_reload=False):
     path = os.path.join(repo_dir, _HUB_CONF)
     if not os.path.isfile(path):
         raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+    key = os.path.abspath(path)
+    if not force_reload and key in _cache:
+        return _cache[key]
     spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules.pop("paddle_tpu_hubconf", None)
     spec.loader.exec_module(mod)
+    _cache[key] = mod
     return mod
 
 
@@ -41,7 +44,7 @@ def _check_source(source):
 def list(repo_dir, source="local", force_reload=False):  # noqa: A001
     """List callable entrypoints defined by repo_dir/hubconf.py."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     return [n for n in dir(mod)
             if callable(getattr(mod, n)) and not n.startswith("_")]
 
@@ -49,7 +52,7 @@ def list(repo_dir, source="local", force_reload=False):  # noqa: A001
 def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
     """Docstring of an entrypoint."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     if not hasattr(mod, model):
         raise RuntimeError(f"entrypoint {model!r} not found in hubconf")
     return getattr(mod, model).__doc__
@@ -58,7 +61,7 @@ def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
 def load(repo_dir, model, source="local", force_reload=False, **kwargs):
     """Call an entrypoint and return its result (usually a Layer)."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     if not hasattr(mod, model):
         raise RuntimeError(f"entrypoint {model!r} not found in hubconf")
     return getattr(mod, model)(**kwargs)

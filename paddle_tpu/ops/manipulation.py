@@ -419,7 +419,12 @@ def as_real(x, name=None):
 
 
 def as_complex(x, name=None):
-    return op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [x])
+    def _primal(a):
+        if a.shape[-1] != 2:
+            raise ValueError("as_complex needs a trailing axis of size 2")
+        return jax.lax.complex(a[..., 0], a[..., 1])
+
+    return op("as_complex", _primal, [x])
 
 
 def numel(x, name=None):

@@ -208,25 +208,4 @@ def angle(x, name=None):
     return op("angle", jnp.angle, [x])
 
 
-def as_complex(x, name=None):
-    """View the last size-2 axis of a real tensor as complex:
-    [..., 2] float -> [...] complex."""
-
-    def _primal(a):
-        if a.shape[-1] != 2:
-            raise ValueError("as_complex needs a trailing axis of size 2")
-        return jax.lax.complex(a[..., 0], a[..., 1])
-
-    return op("as_complex", _primal, [x])
-
-
-def as_real(x, name=None):
-    """Inverse of as_complex: [...] complex -> [..., 2] float."""
-
-    def _primal(a):
-        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
-
-    return op("as_real", _primal, [x])
-
-
-__all__ += ["real", "imag", "conj", "angle", "as_complex", "as_real"]
+__all__ += ["real", "imag", "conj", "angle"]

@@ -1,0 +1,17 @@
+"""paddle.sysconfig (reference `python/paddle/sysconfig.py`): install
+include/lib dirs — here the package's own location, since the TPU build
+links against jax/XLA rather than shipping its own native libs."""
+from __future__ import annotations
+
+import os
+
+__all__ = ['get_include', 'get_lib']
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'include')
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), 'libs')

@@ -338,3 +338,85 @@ class TestReviewRegressions:
                 tot += sce(float(x[n] @ w[ptab[n, l]]), float(pcode[n, l]))
             expect.append([tot])
         np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+class TestTopLevelParity:
+    def test_batch(self):
+        r = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(b) for b in r()] == [3, 3, 1]
+        r = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(b) for b in r()] == [3, 3]
+
+    def test_compiled_with(self):
+        assert paddle.is_compiled_with_cuda() is False
+        assert paddle.is_compiled_with_xpu() is False
+        assert paddle.get_cudnn_version() is None
+
+    def test_iinfo_finfo(self):
+        ii = paddle.iinfo("int32")
+        assert ii.min == -2**31 and ii.max == 2**31 - 1 and ii.bits == 32
+        fi = paddle.finfo("float32")
+        assert fi.max > 3e38 and fi.eps < 1e-6
+        bf = paddle.finfo("bfloat16")
+        assert bf.max > 3e38  # bf16 has f32-like range
+
+    def test_sysconfig(self):
+        assert paddle.sysconfig.get_include().endswith("include")
+        assert paddle.sysconfig.get_lib().endswith("libs")
+
+    def test_flops_linear(self):
+        import paddle_tpu.nn as nn
+
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        total = paddle.flops(net, [2, 16])
+        # 2*(16*32) + 2*32 (relu) + 2*(32*4) = 1024+64+256... reference
+        # counts MACs for linear: batch*in*out
+        assert total == 2 * 16 * 32 + 2 * 32 + 2 * 32 * 4
+
+
+class TestReviewRegressions2:
+    """Round-3 second review batch."""
+
+    def test_flash_supports_non_default_multiples(self):
+        from paddle_tpu.ops.pallas.flash_attention_kernel import (
+            supports, _auto_block)
+
+        # shapes that divided the old 128 blocks must stay supported
+        for S in (768, 1536, 640):
+            assert supports((2, S, 4, 64), (2, S, 4, 64)), S
+        assert _auto_block(1536, 1024) == 512
+        assert _auto_block(768, 512) == 256
+        assert _auto_block(1024, 1024) == 1024
+
+    def test_multinomial_entropy_exact(self):
+        from paddle_tpu import distribution as D
+        from math import lgamma, log
+
+        m = D.Multinomial(2, np.array([0.5, 0.5]))
+        # support {(2,0),(1,1),(0,2)} probs {1/4, 1/2, 1/4}
+        expect = -(0.25 * log(0.25) * 2 + 0.5 * log(0.5))
+        np.testing.assert_allclose(float(m.entropy()), expect, rtol=1e-5)
+
+    def test_chain_injective_nested(self):
+        from paddle_tpu import distribution as D
+
+        inner = D.ChainTransform([D.AbsTransform()])
+        outer = D.ChainTransform([inner, D.ExpTransform()])
+        assert not inner._is_injective()
+        assert not outer._is_injective()
+
+    def test_as_complex_single_impl_validates(self):
+        t = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        with pytest.raises(ValueError):
+            paddle.as_complex(t)
+        with pytest.raises(ValueError):
+            t.as_complex()
+
+    def test_hub_force_reload(self, tmp_path):
+        p = tmp_path / "hubconf.py"
+        p.write_text("def f():\n    return 1\n")
+        assert paddle.hub.load(str(tmp_path), "f") == 1
+        p.write_text("def f():\n    return 2\n")
+        assert paddle.hub.load(str(tmp_path), "f") == 1  # cached
+        assert paddle.hub.load(str(tmp_path), "f",
+                               force_reload=True) == 2
