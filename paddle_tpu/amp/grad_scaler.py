@@ -113,8 +113,13 @@ class GradScaler:
                     old = old_accs[(key, name)]
                 else:
                     # accumulator born this step: roll back to its init
+                    # (derived accumulators re-run their init thunk, e.g.
+                    # master weights from the already-rolled-back param)
                     init = optimizer._acc_inits.get((key, name), 0.0)
-                    old = jnp.full(new.shape, init, new.dtype)
+                    if callable(init):
+                        old = init()
+                    else:
+                        old = jnp.full(new.shape, init, new.dtype)
                 t._set_data(jnp.where(found, old, new))
         self._unscaled = False
 

@@ -38,10 +38,12 @@ def _shard_accumulators(inner: Optimizer, mesh, enable_zero: bool,
     orig = getattr(inner, "_orig_get_accumulator", inner._get_accumulator)
     inner._orig_get_accumulator = orig
 
-    def wrapped(name: str, p: Tensor, init=0.0, dtype=None, shape=None):
+    def wrapped(name: str, p: Tensor, init=0.0, dtype=None, shape=None,
+                init_from=None):
         key = inner._param_key(p)
         fresh = name not in inner._accumulators.get(key, {})
-        t = orig(name, p, init=init, dtype=dtype, shape=shape)
+        t = orig(name, p, init=init, dtype=dtype, shape=shape,
+                 init_from=init_from)
         # place via the concrete payload (t._data, never a tracer for
         # external state) and force eager placement even when a to_static
         # probe trace is active — a traced device_put would store a tracer

@@ -39,7 +39,7 @@ class Optimizer:
                 self._regularizer = weight_decay
         # name → {acc_name: Tensor}
         self._accumulators: Dict[str, Dict[str, Tensor]] = {}
-        self._acc_inits: Dict[tuple, float] = {}
+        self._acc_inits: Dict[tuple, object] = {}  # float init or callable thunk
         self._global_step = 0
 
     # -- lr ----------------------------------------------------------------
@@ -82,7 +82,7 @@ class Optimizer:
         return p.name or f"param_{id(p)}"
 
     def _get_accumulator(self, name: str, p: Tensor, init=0.0,
-                         dtype=None, shape=None) -> Tensor:
+                         dtype=None, shape=None, init_from=None) -> Tensor:
         key = self._param_key(p)
         accs = self._accumulators.setdefault(key, {})
         if name not in accs:
@@ -92,10 +92,16 @@ class Optimizer:
             shape = tuple(p.shape) if shape is None else tuple(shape)
             # external_tensor: accumulators lazily created inside a traced
             # train step must still be persistent program state
-            accs[name] = tensor_mod.external_tensor(
-                lambda: jnp.full(shape, init, dtype=dt))
-            # init value kept for skip-step rollback (amp GradScaler)
-            self._acc_inits[(key, name)] = init
+            if init_from is not None:
+                accs[name] = tensor_mod.external_tensor(init_from)
+            else:
+                accs[name] = tensor_mod.external_tensor(
+                    lambda: jnp.full(shape, init, dtype=dt))
+            # init value kept for skip-step rollback (amp GradScaler);
+            # derived accumulators (master weights) store their thunk so
+            # rollback re-derives from the rolled-back param
+            self._acc_inits[(key, name)] = (
+                init_from if init_from is not None else init)
         return accs[name]
 
     # -- main entry points ---------------------------------------------------
@@ -142,6 +148,33 @@ class Optimizer:
 
     def _apply(self, p: Tensor, new_value):
         p._set_data(new_value.astype(p._value().dtype))
+
+    # -- master weights (AMP-O2 / reference multi_precision) ---------------
+    # When a parameter is stored in a low dtype (bf16/f16 after
+    # amp.decorate), the optimizer keeps an f32 master copy in its
+    # accumulators: updates accumulate in f32 and the param gets the
+    # cast-down view, so lr*grad increments far below bf16 resolution are
+    # not lost (reference: optimizer.py _multi_precision master weights).
+    def _is_low_precision(self, p: Tensor):
+        return p._data.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _master_tensor(self, p: Tensor) -> Tensor:
+        # init thunk reads p._data (the raw payload), which stays the
+        # concrete pre-step array even while a to_static trace is
+        # active (trace reads go through env, not the attribute)
+        return self._get_accumulator(
+            "master_weight", p, dtype=jnp.float32,
+            init_from=lambda: p._data.astype(jnp.float32))
+
+    def _master_value(self, p: Tensor):
+        if self._is_low_precision(p):
+            return self._master_tensor(p)._value().astype(jnp.float32)
+        return p._value().astype(jnp.float32)
+
+    def _apply_master(self, p: Tensor, new32):
+        if self._is_low_precision(p):
+            self._master_tensor(p)._set_data(new32)
+        self._apply(p, new32)
 
     def _update_param(self, p: Tensor, g):
         raise NotImplementedError
@@ -226,8 +259,9 @@ class SGD(Optimizer):
 
     def _update_param(self, p, g):
         g = self._decayed_grad(p, g)
-        lr = self._lr_array().astype(g.dtype)
-        self._apply(p, p._value() - lr * g)
+        lr = self._lr_array()
+        self._apply_master(p, self._master_value(p)
+                           - lr * g.astype(jnp.float32))
 
 
 class Momentum(Optimizer):
@@ -241,14 +275,16 @@ class Momentum(Optimizer):
     def _update_param(self, p, g):
         g = self._decayed_grad(p, g)
         lr = self._lr_array().astype(g.dtype)
-        vel = self._get_accumulator("velocity", p)
+        vel = self._get_accumulator("velocity", p, dtype=jnp.float32)
         v_new = self._momentum * vel._value().astype(g.dtype) + g
         vel._set_data(v_new.astype(vel._value().dtype))
         if self._use_nesterov:
             upd = g + self._momentum * v_new
         else:
             upd = v_new
-        self._apply(p, p._value() - lr * upd)
+        self._apply_master(p, self._master_value(p)
+                           - lr.astype(jnp.float32)
+                           * upd.astype(jnp.float32))
 
 
 class Adam(Optimizer):
@@ -279,10 +315,11 @@ class Adam(Optimizer):
         b2p._set_data(b2p_new)
         m_hat = m_new / (1.0 - b1p_new)
         v_hat = v_new / (1.0 - b2p_new)
-        p32 = p._value().astype(jnp.float32)
+        p32 = self._master_value(p)
         if decoupled_wd:
             p32 = p32 * (1.0 - lr * decoupled_wd)
-        self._apply(p, p32 - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon))
+        new32 = p32 - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        self._apply_master(p, new32)
 
     def _update_param(self, p, g):
         g = self._decayed_grad(p, g)
@@ -328,8 +365,9 @@ class Adamax(Optimizer):
         u_new = jnp.maximum(self._beta2 * u._value(), jnp.abs(g32))
         b1p_new = b1p._value() * self._beta1
         m._set_data(m_new); u._set_data(u_new); b1p._set_data(b1p_new)
-        self._apply(p, p._value().astype(jnp.float32)
-                    - lr / (1 - b1p_new) * m_new / (u_new + self._epsilon))
+        self._apply_master(p, self._master_value(p)
+                           - lr / (1 - b1p_new) * m_new
+                           / (u_new + self._epsilon))
 
 
 class Adagrad(Optimizer):
@@ -348,8 +386,9 @@ class Adagrad(Optimizer):
         g32 = g.astype(jnp.float32)
         acc_new = acc._value() + jnp.square(g32)
         acc._set_data(acc_new)
-        self._apply(p, p._value().astype(jnp.float32)
-                    - lr * g32 / (jnp.sqrt(acc_new) + self._epsilon))
+        self._apply_master(p, self._master_value(p)
+                           - lr * g32
+                           / (jnp.sqrt(acc_new) + self._epsilon))
 
 
 class RMSProp(Optimizer):
@@ -376,7 +415,7 @@ class RMSProp(Optimizer):
             denom = ms_new - jnp.square(mg_new)
         upd = self._momentum * mom._value() + lr * g32 / jnp.sqrt(denom + self._epsilon)
         mom._set_data(upd)
-        self._apply(p, p._value().astype(jnp.float32) - upd)
+        self._apply_master(p, self._master_value(p) - upd)
 
 
 class Adadelta(Optimizer):
@@ -397,7 +436,7 @@ class Adadelta(Optimizer):
         asu = self._rho * avg_sq_u._value() + (1 - self._rho) * jnp.square(upd)
         avg_sq_g._set_data(asg)
         avg_sq_u._set_data(asu)
-        self._apply(p, p._value().astype(jnp.float32) + lr * upd)
+        self._apply_master(p, self._master_value(p) + lr * upd)
 
 
 class Lamb(Optimizer):
@@ -427,7 +466,7 @@ class Lamb(Optimizer):
         b1p._set_data(b1p_new); b2p._set_data(b2p_new)
         m_hat = m_new / (1 - b1p_new)
         v_hat = v_new / (1 - b2p_new)
-        p32 = p._value().astype(jnp.float32)
+        p32 = self._master_value(p)
         wd = self._wd
         if self._exclude_fn is not None and self._exclude_fn(p):
             wd = 0.0
@@ -435,4 +474,4 @@ class Lamb(Optimizer):
         w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
-        self._apply(p, p32 - lr * trust * r)
+        self._apply_master(p, p32 - lr * trust * r)

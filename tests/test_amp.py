@@ -164,3 +164,121 @@ class TestGradScaler:
         for _ in range(10):
             ln = float(step(x, y))
         assert ln < l0
+
+
+class TestO2MasterWeights:
+    """AMP-O2: bf16 params + f32 master copies in the optimizer
+    (reference multi_precision): tiny updates below bf16 resolution must
+    accumulate instead of vanishing."""
+
+    def test_small_updates_accumulate(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4, bias_attr=False)
+        lin = paddle.amp.decorate(lin, level="O2")
+        assert str(lin.weight.dtype) in ("bfloat16", "uint16")
+        opt = paddle.optimizer.SGD(1e-4, parameters=lin.parameters())
+        w0 = np.asarray(lin.weight.numpy(), np.float32).copy()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(64):
+            with paddle.amp.auto_cast(dtype="bfloat16", level="O2"):
+                loss = lin(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        w1 = np.asarray(lin.weight.numpy(), np.float32)
+        # grad = 2 (two rows of ones) per weight; 64 steps of 1e-4*2
+        # = 1.28e-2 total — each single step is below bf16 resolution
+        # for weights ~O(0.5), but the master must accumulate them
+        drift = np.abs(w1 - w0).mean()
+        assert drift > 5e-3, drift
+
+    def test_adamw_o2_matches_f32_closely(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        def run(o2):
+            paddle.seed(1)
+            net = nn.Linear(8, 8)
+            if o2:
+                net = paddle.amp.decorate(net, level="O2")
+            opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+            rs = np.random.RandomState(0)
+            x = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+            y = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+            for _ in range(20):
+                with paddle.amp.auto_cast(dtype="bfloat16",
+                                          level="O2" if o2 else "O1"):
+                    loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return float(loss)
+
+        lf32, lo2 = run(False), run(True)
+        assert abs(lf32 - lo2) / abs(lf32) < 0.1, (lf32, lo2)
+
+    def test_scaler_skip_rolls_back_master(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(2)
+        lin = nn.Linear(2, 2, bias_attr=False)
+        lin = paddle.amp.decorate(lin, level="O2")
+        opt = paddle.optimizer.AdamW(0.1, parameters=lin.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        w0 = np.asarray(lin.weight.numpy(), np.float32).copy()
+        x = paddle.to_tensor(np.full((1, 2), np.inf, np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O2"):
+            loss = lin(x).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        # inf grads -> step skipped; param AND master must be unchanged
+        np.testing.assert_allclose(
+            np.asarray(lin.weight.numpy(), np.float32), w0)
+        accs = opt._accumulators.get(opt._param_key(lin.weight), {})
+        if "master_weight" in accs:
+            np.testing.assert_allclose(
+                np.asarray(accs["master_weight"].numpy()), w0, rtol=1e-2)
+
+    def test_all_optimizers_o2_accumulate(self):
+        """Every optimizer class must route O2 params through the f32
+        master path (review finding: Adamax/Adagrad/RMSProp/Adadelta/Lamb
+        initially bypassed it)."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        mk = [
+            lambda ps: paddle.optimizer.Adamax(1e-4, parameters=ps),
+            lambda ps: paddle.optimizer.Adagrad(1e-4, parameters=ps),
+            lambda ps: paddle.optimizer.RMSProp(1e-4, parameters=ps),
+            lambda ps: paddle.optimizer.Adadelta(
+                learning_rate=1.0, parameters=ps),
+            lambda ps: paddle.optimizer.Lamb(1e-4, parameters=ps),
+        ]
+        for make in mk:
+            paddle.seed(0)
+            lin = nn.Linear(4, 4, bias_attr=False)
+            lin = paddle.amp.decorate(lin, level="O2")
+            opt = make(lin.parameters())
+            w0 = np.asarray(lin.weight.numpy(), np.float32).copy()
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            for _ in range(50):
+                with paddle.amp.auto_cast(dtype="bfloat16", level="O2"):
+                    loss = lin(x).sum()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            w1 = np.asarray(lin.weight.numpy(), np.float32)
+            name = type(opt).__name__
+            assert np.abs(w1 - w0).mean() > 1e-4, name
+            accs = opt._accumulators.get(opt._param_key(lin.weight), {})
+            assert "master_weight" in accs, name
