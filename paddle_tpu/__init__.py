@@ -59,7 +59,7 @@ from .ops.logic import is_tensor
 from . import (  # noqa: F401
     nn, optimizer, amp, io, jit, vision, metric, distributed, autograd,
     framework, profiler, incubate, hapi, static, text, utils, inference,
-    distribution, fft, signal, regularizer, hub, version,
+    distribution, fft, signal, regularizer, hub, version, sparse,
 )
 
 __version__ = version.full_version

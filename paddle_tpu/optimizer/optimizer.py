@@ -128,8 +128,9 @@ class Optimizer:
         self._global_step += 1
         for p, g in params_grads:
             garr = g._value() if isinstance(g, Tensor) else g
-            self._update_param(p, garr.astype(jnp.float32)
-                               if garr.dtype == jnp.bfloat16 else garr)
+            if garr.dtype in (jnp.bfloat16, jnp.float16):
+                garr = garr.astype(jnp.float32)
+            self._update_param(p, garr)
 
     minimize_step = step
 
@@ -274,17 +275,18 @@ class Momentum(Optimizer):
 
     def _update_param(self, p, g):
         g = self._decayed_grad(p, g)
-        lr = self._lr_array().astype(g.dtype)
+        # all update math in f32: an f16/bf16 lr or velocity would flush
+        # warmup-scale values (< f16 subnormal floor) to zero
+        lr = self._lr_array()
+        g32 = g.astype(jnp.float32)
         vel = self._get_accumulator("velocity", p, dtype=jnp.float32)
-        v_new = self._momentum * vel._value().astype(g.dtype) + g
-        vel._set_data(v_new.astype(vel._value().dtype))
+        v_new = self._momentum * vel._value() + g32
+        vel._set_data(v_new)
         if self._use_nesterov:
-            upd = g + self._momentum * v_new
+            upd = g32 + self._momentum * v_new
         else:
             upd = v_new
-        self._apply_master(p, self._master_value(p)
-                           - lr.astype(jnp.float32)
-                           * upd.astype(jnp.float32))
+        self._apply_master(p, self._master_value(p) - lr * upd)
 
 
 class Adam(Optimizer):

@@ -1,0 +1,173 @@
+"""paddle.sparse — sparse COO/CSR tensors and ops (reference
+`python/paddle/incubate/sparse/__init__.py`; also re-exported at
+`paddle.incubate.sparse` for 2.3-era import paths)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import op, unwrap, wrap
+from .tensor import SparseCooTensor, SparseCsrTensor, _as_tensor
+from . import nn  # noqa: F401
+
+__all__ = [
+    'sparse_coo_tensor', 'sparse_csr_tensor', 'SparseCooTensor',
+    'SparseCsrTensor', 'sqrt', 'sin', 'tanh', 'relu', 'abs',
+    'matmul', 'masked_matmul', 'add', 'subtract', 'multiply', 'divide',
+    'is_sparse', 'nn',
+]
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build a COO tensor (reference `creation.py sparse_coo_tensor`)."""
+    idx = _as_tensor(indices)
+    vals = _as_tensor(values)
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+
+        vals = wrap(unwrap(vals).astype(dtype_mod.convert_dtype(dtype)))
+    if shape is None:
+        arr = np.asarray(unwrap(idx))
+        spatial = tuple(int(m) + 1 for m in arr.max(axis=1))
+        shape = spatial + tuple(vals.shape[1:])
+    t = SparseCooTensor(idx, vals, shape)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = _as_tensor(values)
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+
+        vals = wrap(unwrap(vals).astype(dtype_mod.convert_dtype(dtype)))
+    t = SparseCsrTensor(crows, cols, vals, shape)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+# -- unary: elementwise on values (zero-preserving fns only, like the
+# reference's sparse unary kernel set) --------------------------------
+
+def _unary(name, fn):
+    def apply(x):
+        if not is_sparse(x):
+            raise TypeError(f"sparse.{name} expects a sparse tensor")
+        return x._replace_values(op(f"sparse_{name}", fn, [x.values()]))
+
+    apply.__name__ = name
+    return apply
+
+
+sqrt = _unary("sqrt", jnp.sqrt)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+abs = _unary("abs", jnp.abs)  # noqa: A001
+
+
+# -- binary ------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse [M,N] @ dense [N,K] → dense (reference `binary.py matmul`,
+    CSR×dense).  Lowered to a gather + scatter-add: rows/cols are static
+    host indices, the MXU-relevant inner product stays dense."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("matmul expects a sparse lhs")
+    if len(x.shape) != 2:
+        raise ValueError("sparse matmul supports 2-D lhs")
+    y = y if isinstance(y, Tensor) else _as_tensor(y)
+    idx = np.asarray(unwrap(x.indices()))
+    rows = jnp.asarray(idx[0])
+    cols = jnp.asarray(idx[1])
+    M = x.shape[0]
+
+    def _primal(v, d):
+        gathered = d[cols]                       # [nnz, K]
+        contrib = v[:, None] * gathered          # [nnz, K]
+        return jnp.zeros((M, d.shape[1]), contrib.dtype).at[rows].add(
+            contrib)
+
+    return op("sparse_matmul", _primal, [x.values(), y])
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense x @ dense y) sampled at `mask`'s sparsity pattern →
+    sparse with mask's pattern (reference `binary.py masked_matmul`,
+    the SDDMM kernel)."""
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        csr_out = True
+    elif isinstance(mask, SparseCooTensor):
+        coo = mask
+        csr_out = False
+    else:
+        raise TypeError("mask must be sparse")
+    x = x if isinstance(x, Tensor) else _as_tensor(x)
+    y = y if isinstance(y, Tensor) else _as_tensor(y)
+    idx = np.asarray(unwrap(coo.indices()))
+    rows = jnp.asarray(idx[0])
+    cols = jnp.asarray(idx[1])
+
+    def _primal(a, b):
+        return jnp.einsum("nk,nk->n", a[rows], b.T[cols])
+
+    vals = op("sparse_masked_matmul", _primal, [x, y])
+    out = SparseCooTensor(idx, vals, (x.shape[0], y.shape[1]),
+                          coalesced=True)
+    return out.to_sparse_csr() if csr_out else out
+
+
+# -- math: sparse ∘ sparse elementwise ---------------------------------
+
+def _ewise(name, fn):
+    def apply(x, y, name_=None):
+        if not (is_sparse(x) and is_sparse(y)):
+            raise TypeError(f"sparse.{name} expects two sparse tensors")
+        was_csr = x.is_sparse_csr()
+        a = x.to_sparse_coo() if x.is_sparse_csr() else x.coalesce()
+        b = y.to_sparse_coo() if y.is_sparse_csr() else y.coalesce()
+        if tuple(a.shape) != tuple(b.shape):
+            raise ValueError("shape mismatch")
+        # union of patterns via host-side index plan
+        ia = np.asarray(unwrap(a.indices()))
+        ib = np.asarray(unwrap(b.indices()))
+        sd = ia.shape[0]
+        spatial = tuple(a.shape[:sd])
+        fa = np.ravel_multi_index(tuple(ia), spatial)
+        fb = np.ravel_multi_index(tuple(ib), spatial)
+        union = np.union1d(fa, fb)
+        pa = np.searchsorted(union, fa)
+        pb = np.searchsorted(union, fb)
+        n = len(union)
+        out_idx = np.stack(np.unravel_index(union, spatial))
+        pa_j, pb_j = jnp.asarray(pa), jnp.asarray(pb)
+
+        def _primal(va, vb):
+            dense_a = jnp.zeros((n,) + va.shape[1:], va.dtype).at[
+                pa_j].set(va)
+            dense_b = jnp.zeros((n,) + vb.shape[1:], vb.dtype).at[
+                pb_j].set(vb)
+            return fn(dense_a, dense_b)
+
+        vals = op(f"sparse_{name}", _primal, [a.values(), b.values()])
+        out = SparseCooTensor(out_idx, vals, a.shape, coalesced=True)
+        return out.to_sparse_csr() if was_csr else out
+
+    apply.__name__ = name
+    return apply
+
+
+add = _ewise("add", lambda a, b: a + b)
+subtract = _ewise("subtract", lambda a, b: a - b)
+multiply = _ewise("multiply", lambda a, b: a * b)
+divide = _ewise("divide", lambda a, b: a / b)

@@ -1,0 +1,334 @@
+"""paddle.sparse.nn — sparse layers (reference
+`python/paddle/incubate/sparse/nn/`): ReLU, Softmax, BatchNorm, Conv3D,
+SubmConv3D, MaxPool3D.
+
+TPU realization of sparse 3-D convolution: the reference's CUDA kernels
+build a "rulebook" (input-site → output-site pairs per kernel offset) and
+run gather-GEMM-scatter (`paddle/phi/kernels/sparse/gpu/convolution.cu`).
+Here the rulebook is a host-side numpy plan over the (concrete) indices,
+and the per-offset GEMMs are dense MXU matmuls over gathered value rows —
+the same structure, scheduled by XLA."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..ops._helpers import op, unwrap, wrap
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+__all__ = ['ReLU', 'Softmax', 'BatchNorm', 'Conv3D', 'SubmConv3D',
+           'MaxPool3D']
+
+
+# ---------------------------------------------------------------- helpers
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _site_table(sites):
+    """dict mapping site tuple -> row id."""
+    return {tuple(s): i for i, s in enumerate(sites)}
+
+
+def _conv_out_sites(idx, spatial, kernel, stride, padding, subm):
+    """Rulebook: returns (out_sites [n_out, 4], pairs per kernel offset:
+    list of (in_rows, out_rows)).  idx: [4, nnz] (batch, d, h, w)."""
+    nnz = idx.shape[1]
+    in_sites = idx.T                                  # [nnz, 4]
+    kd, kh, kw = kernel
+    if subm:
+        if kd % 2 == 0 or kh % 2 == 0 or kw % 2 == 0:
+            raise ValueError("SubmConv3D requires odd kernel sizes")
+        if tuple(stride) != (1, 1, 1):
+            raise ValueError("SubmConv3D requires stride 1 (the output "
+                             "pattern equals the input pattern)")
+        # center the window on the output site regardless of the padding
+        # argument (spconv submanifold semantics)
+        stride = (1, 1, 1)
+        padding = (kd // 2, kh // 2, kw // 2)
+    sd, sh, sw = stride
+    pd, ph, pw = padding
+    D, H, W = spatial
+
+    if subm:
+        # submanifold: output sites/spatial == input sites/spatial; window
+        # is centered on the output site (spconv semantics — stride 1,
+        # odd kernel, implicit center padding)
+        outD, outH, outW = D, H, W
+        out_spatial = (D, H, W)
+        out_sites = in_sites.copy()
+        table = _site_table(out_sites)
+    else:
+        outD = (D + 2 * pd - kd) // sd + 1
+        outH = (H + 2 * ph - kh) // sh + 1
+        outW = (W + 2 * pw - kw) // sw + 1
+        out_spatial = (outD, outH, outW)
+        seen = {}
+        out_list = []
+        # enumerate reachable output sites per input site
+        for s in in_sites:
+            b, d, h, w = int(s[0]), int(s[1]), int(s[2]), int(s[3])
+            for kz in range(kd):
+                oz, rz = divmod(d + pd - kz, sd)
+                if rz or not (0 <= oz < outD):
+                    continue
+                for ky in range(kh):
+                    oy, ry = divmod(h + ph - ky, sh)
+                    if ry or not (0 <= oy < outH):
+                        continue
+                    for kx in range(kw):
+                        ox, rx = divmod(w + pw - kx, sw)
+                        if rx or not (0 <= ox < outW):
+                            continue
+                        key = (b, oz, oy, ox)
+                        if key not in seen:
+                            seen[key] = len(out_list)
+                            out_list.append(key)
+        out_sites = np.array(sorted(out_list), np.int64).reshape(-1, 4)
+        table = _site_table(out_sites)
+
+    pairs = []
+    for kz in range(kd):
+        for ky in range(kh):
+            for kx in range(kw):
+                in_rows, out_rows = [], []
+                for i, s in enumerate(in_sites):
+                    b, d, h, w = int(s[0]), int(s[1]), int(s[2]), int(s[3])
+                    oz, rz = divmod(d + pd - kz, sd)
+                    oy, ry = divmod(h + ph - ky, sh)
+                    ox, rx = divmod(w + pw - kx, sw)
+                    if rz or ry or rx:
+                        continue
+                    key = (b, oz, oy, ox)
+                    row = table.get(key)
+                    if row is not None and 0 <= oz < outD \
+                            and 0 <= oy < outH and 0 <= ox < outW:
+                        in_rows.append(i)
+                        out_rows.append(row)
+                pairs.append((np.array(in_rows, np.int64),
+                              np.array(out_rows, np.int64)))
+    return out_sites, out_spatial, pairs
+
+
+def _sparse_conv3d(x: SparseCooTensor, weight: Tensor, bias, kernel,
+                   stride, padding, subm):
+    idx = np.asarray(unwrap(x.indices()))
+    if idx.shape[0] != 4:
+        raise ValueError("sparse conv3d expects NDHWC layout with "
+                         "indices [4, nnz] (batch, d, h, w)")
+    spatial = tuple(x.shape[1:4])
+    out_ch = int(weight.shape[-1])
+    out_sites, out_spatial, pairs = _conv_out_sites(
+        idx, spatial, kernel, stride, padding, subm)
+    n_out = len(out_sites)
+    pairs_j = [(jnp.asarray(a), jnp.asarray(b)) for a, b in pairs]
+
+    def _primal(v, w, *maybe_bias):
+        wk = w.reshape(-1, w.shape[-2], w.shape[-1])    # [K, Cin, Cout]
+        out = jnp.zeros((n_out, out_ch), jnp.result_type(v, w))
+        for k, (ir, orow) in enumerate(pairs_j):
+            if ir.shape[0] == 0:
+                continue
+            contrib = v[ir] @ wk[k]                      # gather-GEMM
+            out = out.at[orow].add(contrib)              # scatter
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    args = [x.values(), weight] + ([bias] if bias is not None else [])
+    vals = op("sparse_conv3d", _primal, args)
+    out_shape = (x.shape[0],) + out_spatial + (out_ch,)
+    return SparseCooTensor(out_sites.T, vals, out_shape, coalesced=True)
+
+
+def _sparse_maxpool3d(x: SparseCooTensor, kernel, stride, padding):
+    idx = np.asarray(unwrap(x.indices()))
+    spatial = tuple(x.shape[1:4])
+    out_sites, out_spatial, pairs = _conv_out_sites(
+        idx, spatial, kernel, stride, padding, subm=False)
+    n_out = len(out_sites)
+    all_in = np.concatenate([a for a, _ in pairs])
+    all_out = np.concatenate([b for _, b in pairs])
+    in_j, out_j = jnp.asarray(all_in), jnp.asarray(all_out)
+
+    def _primal(v):
+        neg = jnp.full((n_out, v.shape[-1]), -jnp.inf, v.dtype)
+        return neg.at[out_j].max(v[in_j])
+
+    vals = op("sparse_maxpool3d", _primal, [x.values()])
+    out_shape = (x.shape[0],) + out_spatial + (x.shape[-1],)
+    return SparseCooTensor(out_sites.T, vals, out_shape, coalesced=True)
+
+
+# ---------------------------------------------------------------- layers
+class ReLU(Layer):
+    def forward(self, x):
+        return x._replace_values(
+            op("sparse_relu", lambda v: jnp.maximum(v, 0), [x.values()]))
+
+
+class Softmax(Layer):
+    """CSR row-wise softmax over stored entries (reference
+    `sparse/nn/layer/activation.py Softmax`, axis=-1 only)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1")
+
+    def forward(self, x):
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse Softmax expects a SparseCsrTensor")
+        rows = jnp.asarray(x._row_ids())
+        M = x.shape[0]
+
+        def _primal(v):
+            rmax = jnp.full((M,), -jnp.inf, v.dtype).at[rows].max(v)
+            e = jnp.exp(v - rmax[rows])
+            rsum = jnp.zeros((M,), v.dtype).at[rows].add(e)
+            return e / rsum[rows]
+
+        return x._replace_values(
+            op("sparse_softmax", _primal, [x.values()]))
+
+
+class BatchNorm(Layer):
+    """Per-channel batch norm over active sites (reference
+    `sparse/nn/layer/norm.py BatchNorm`)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format='NDHWC',
+                 name=None):
+        super().__init__()
+        if data_format != 'NDHWC':
+            raise ValueError("sparse BatchNorm supports NDHWC")
+        self._momentum = momentum
+        self._epsilon = epsilon
+        from ..nn import initializer as init
+
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=init.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], is_bias=True,
+            default_initializer=init.Constant(0.0))
+        self._mean = Tensor._wrap(jnp.zeros((num_features,), jnp.float32))
+        self._variance = Tensor._wrap(jnp.ones((num_features,),
+                                               jnp.float32))
+        self.register_buffer("_mean", self._mean)
+        self.register_buffer("_variance", self._variance)
+
+    def forward(self, x):
+        training = self.training
+        mom = self._momentum
+        eps = self._epsilon
+
+        if training:
+            def _primal(v, w, b, rm, rv):
+                mean = jnp.mean(v, axis=0)
+                var = jnp.var(v, axis=0)
+                vhat = (v - mean) * jax.lax.rsqrt(var + eps)
+                return vhat * w + b, mom * rm + (1 - mom) * mean, \
+                    mom * rv + (1 - mom) * var
+
+            vals, new_m, new_v = op(
+                "sparse_batch_norm", _primal,
+                [x.values(), self.weight, self.bias, self._mean,
+                 self._variance], n_outs=3)
+            self._mean._set_data(unwrap(new_m))
+            self._variance._set_data(unwrap(new_v))
+            return x._replace_values(vals)
+
+        def _primal(v, w, b, rm, rv):
+            return (v - rm) * jax.lax.rsqrt(rv + eps) * w + b
+
+        return x._replace_values(op(
+            "sparse_batch_norm_eval", _primal,
+            [x.values(), self.weight, self.bias, self._mean,
+             self._variance]))
+
+
+class _Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode='zeros', weight_attr=None, bias_attr=None,
+                 data_format='NDHWC'):
+        super().__init__()
+        if data_format != 'NDHWC':
+            raise ValueError("sparse conv supports NDHWC")
+        if groups != 1 or _triple(dilation) != (1, 1, 1):
+            raise ValueError("sparse conv supports groups=1, dilation=1")
+        self._kernel = _triple(kernel_size)
+        self._stride = _triple(stride)
+        self._padding = _triple(padding)
+        self._subm = subm
+        kd, kh, kw = self._kernel
+        from ..nn import initializer as init
+
+        fan_in = in_channels * kd * kh * kw
+        std = (2.0 / fan_in) ** 0.5
+        self.weight = self.create_parameter(
+            [kd, kh, kw, in_channels, out_channels],
+            default_initializer=init.Normal(0.0, std))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], is_bias=True,
+                default_initializer=init.Constant(0.0))
+
+    def forward(self, x):
+        return _sparse_conv3d(x, self.weight, self.bias, self._kernel,
+                              self._stride, self._padding, self._subm)
+
+
+class Conv3D(_Conv3D):
+    """Sparse 3-D convolution — output sites are every position the kernel
+    reaches from an input site (reference `sparse/nn/layer/conv.py
+    Conv3D`)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NDHWC'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_Conv3D):
+    """Submanifold sparse conv — output sites equal input sites, so deep
+    stacks do not dilate the active set (reference SubmConv3D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format='NDHWC'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format='NDHWC',
+                 name=None):
+        super().__init__()
+        if data_format != 'NDHWC':
+            raise ValueError("sparse MaxPool3D supports NDHWC")
+        if return_mask:
+            raise NotImplementedError(
+                "sparse MaxPool3D return_mask is not supported")
+        if ceil_mode:
+            raise NotImplementedError(
+                "sparse MaxPool3D ceil_mode is not supported")
+        self._kernel = _triple(kernel_size)
+        self._stride = _triple(stride if stride is not None
+                               else kernel_size)
+        self._padding = _triple(padding)
+
+    def forward(self, x):
+        return _sparse_maxpool3d(x, self._kernel, self._stride,
+                                 self._padding)

@@ -1,0 +1,219 @@
+"""Sparse tensor types (reference: `paddle/phi/core/sparse_coo_tensor.h`,
+`sparse_csr_tensor.h`; python surface `python/paddle/incubate/sparse/`).
+
+TPU-native design: a sparse tensor is a thin Python object holding dense
+index/value Tensors — the values ride the normal dispatch tape, so every
+sparse op is differentiable w.r.t. values with no extra autograd machinery
+(the reference needs dedicated sparse grad kernels).  Compute lowers to
+gather/scatter/segment ops XLA handles natively; there is no dedicated
+sparse runtime format (on TPU the MXU wants dense tiles — ops densify at
+the smallest profitable granularity, which the reference's
+gather-gemm-scatter CUDA kernels also do)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import op, unwrap, wrap
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return wrap(jnp.asarray(np.asarray(x)))
+
+
+class SparseCooTensor:
+    """COO: `indices` [sparse_dim, nnz] int, `values` [nnz, *dense_dims]."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = _as_tensor(indices)
+        self._values = _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+        sd = self._indices.shape[0]
+        nnz = self._indices.shape[1] if len(self._indices.shape) > 1 else 0
+        if self._values.shape[0] != nnz:
+            raise ValueError(
+                f"values nnz {self._values.shape[0]} != indices nnz {nnz}")
+        if sd + (len(self._values.shape) - 1) != len(self._shape):
+            raise ValueError(
+                f"sparse_dim {sd} + dense dims "
+                f"{len(self._values.shape) - 1} != rank {len(self._shape)}")
+
+    # -- attributes (reference varbase_patch_methods surface) -----------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+    def _replace_values(self, new_values):
+        return SparseCooTensor(self._indices, new_values, self._shape,
+                               self._coalesced)
+
+    def to_dense(self):
+        idx = unwrap(self._indices).astype(jnp.int32)
+        shape = self._shape
+        sd = idx.shape[0]
+
+        def _primal(v):
+            out = jnp.zeros(shape, v.dtype)
+            return out.at[tuple(idx[d] for d in range(sd))].add(v)
+
+        return op("sparse_coo_to_dense", _primal, [self._values])
+
+    def to_sparse_csr(self):
+        """2-D only, coalesced row-major indices."""
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        coo = self.coalesce()
+        idx = np.asarray(unwrap(coo._indices))
+        rows, cols = idx[0], idx[1]
+        crows = np.zeros(self._shape[0] + 1, np.int64)
+        np.add.at(crows[1:], rows, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(crows, cols, coo._values, self._shape)
+
+    def coalesce(self):
+        """Sort indices row-major and sum duplicates (host-side index
+        plan + on-device segment sum, like the reference's coalesce
+        kernel)."""
+        idx = np.asarray(unwrap(self._indices))
+        flat = np.ravel_multi_index(
+            tuple(idx), tuple(self._shape[:idx.shape[0]]))
+        order = np.argsort(flat, kind="stable")
+        sorted_flat = flat[order]
+        uniq, seg = np.unique(sorted_flat, return_inverse=True)
+        new_idx = np.stack(np.unravel_index(
+            uniq, tuple(self._shape[:idx.shape[0]])))
+        n_out = len(uniq)
+        order_j = jnp.asarray(order)
+        seg_j = jnp.asarray(seg)
+
+        def _primal(v):
+            return jnp.zeros((n_out,) + v.shape[1:], v.dtype).at[
+                seg_j].add(v[order_j])
+
+        vals = op("sparse_coo_coalesce", _primal, [self._values])
+        return SparseCooTensor(new_idx, vals, self._shape, coalesced=True)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={list(self._shape)}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR: `crows` [M+1], `cols` [nnz], `values` [nnz] (2-D)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _as_tensor(crows)
+        self._cols = _as_tensor(cols)
+        self._values = _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D shapes")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+    def _row_ids(self):
+        crows = np.asarray(unwrap(self._crows))
+        return np.repeat(np.arange(self._shape[0]), np.diff(crows))
+
+    def _replace_values(self, new_values):
+        return SparseCsrTensor(self._crows, self._cols, new_values,
+                               self._shape)
+
+    def to_dense(self):
+        rows = jnp.asarray(self._row_ids())
+        cols = unwrap(self._cols).astype(jnp.int32)
+        shape = self._shape
+
+        def _primal(v):
+            return jnp.zeros(shape, v.dtype).at[rows, cols].add(v)
+
+        return op("sparse_csr_to_dense", _primal, [self._values])
+
+    def to_sparse_coo(self, sparse_dim=2):
+        if sparse_dim != 2:
+            raise ValueError("CSR→COO supports sparse_dim=2")
+        idx = np.stack([self._row_ids(),
+                        np.asarray(unwrap(self._cols))])
+        return SparseCooTensor(idx, self._values, self._shape,
+                               coalesced=True)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={list(self._shape)}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")

@@ -244,9 +244,9 @@ class TestO2MasterWeights:
         np.testing.assert_allclose(
             np.asarray(lin.weight.numpy(), np.float32), w0)
         accs = opt._accumulators.get(opt._param_key(lin.weight), {})
-        if "master_weight" in accs:
-            np.testing.assert_allclose(
-                np.asarray(accs["master_weight"].numpy()), w0, rtol=1e-2)
+        assert "master_weight" in accs
+        np.testing.assert_allclose(
+            np.asarray(accs["master_weight"].numpy()), w0, rtol=1e-2)
 
     def test_all_optimizers_o2_accumulate(self):
         """Every optimizer class must route O2 params through the f32

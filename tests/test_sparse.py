@@ -1,0 +1,279 @@
+"""paddle.sparse: COO/CSR tensors, unary/binary/math ops, sparse nn
+layers — all against dense numpy oracles (reference test strategy:
+unittests/test_sparse_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def _rand_coo(shape, nnz, seed=0, dense_dims=0):
+    rs = np.random.RandomState(seed)
+    sd = len(shape) - dense_dims
+    # unique sites
+    flat = rs.choice(int(np.prod(shape[:sd])), nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, shape[:sd]))
+    vals = rs.randn(nnz, *shape[sd:]).astype(np.float32)
+    return idx, vals
+
+
+class TestSparseTensors:
+    def test_coo_create_to_dense(self):
+        idx, vals = _rand_coo((4, 5), 6)
+        t = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+        assert t.is_sparse_coo() and not t.is_sparse_csr()
+        assert t.nnz() == 6
+        dense = np.zeros((4, 5), np.float32)
+        dense[idx[0], idx[1]] = vals
+        np.testing.assert_allclose(_np(t.to_dense()), dense)
+
+    def test_coo_coalesce_sums_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        t = sparse.sparse_coo_tensor(idx, vals, (2, 3)).coalesce()
+        assert t.nnz() == 2
+        dense = _np(t.to_dense())
+        assert dense[0, 1] == 3.0 and dense[1, 2] == 3.0
+
+    def test_csr_roundtrip(self):
+        idx, vals = _rand_coo((5, 6), 8, seed=1)
+        coo = sparse.sparse_coo_tensor(idx, vals, (5, 6))
+        csr = coo.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_allclose(_np(csr.to_dense()), _np(coo.to_dense()))
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(_np(back.to_dense()),
+                                   _np(coo.to_dense()))
+
+    def test_csr_create(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 1]
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+        t = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        dense = np.zeros((3, 4), np.float32)
+        dense[0, 1], dense[0, 3], dense[1, 2] = 1, 2, 3
+        dense[2, 0], dense[2, 1] = 4, 5
+        np.testing.assert_allclose(_np(t.to_dense()), dense)
+
+
+class TestSparseOps:
+    def test_unary(self):
+        idx, vals = _rand_coo((4, 4), 5, seed=2)
+        vals = np.abs(vals) + 0.5
+        t = sparse.sparse_coo_tensor(idx, vals, (4, 4))
+        np.testing.assert_allclose(_np(sparse.sqrt(t).values()),
+                                   np.sqrt(vals), rtol=1e-6)
+        np.testing.assert_allclose(_np(sparse.sin(t).values()),
+                                   np.sin(vals), rtol=1e-6)
+        np.testing.assert_allclose(_np(sparse.tanh(t).values()),
+                                   np.tanh(vals), rtol=1e-6)
+        neg = sparse.sparse_coo_tensor(idx, -vals, (4, 4))
+        np.testing.assert_allclose(_np(sparse.relu(neg).values()),
+                                   np.zeros_like(vals))
+
+    def test_matmul_vs_dense(self):
+        idx, vals = _rand_coo((6, 5), 9, seed=3)
+        t = sparse.sparse_coo_tensor(idx, vals, (6, 5))
+        rs = np.random.RandomState(0)
+        d = rs.randn(5, 7).astype(np.float32)
+        out = _np(sparse.matmul(t, paddle.to_tensor(d)))
+        np.testing.assert_allclose(out, _np(t.to_dense()) @ d, rtol=1e-5,
+                                   atol=1e-5)
+        # csr lhs too
+        out2 = _np(sparse.matmul(t.to_sparse_csr(), paddle.to_tensor(d)))
+        np.testing.assert_allclose(out2, out, rtol=1e-5, atol=1e-5)
+
+    def test_matmul_grad(self):
+        idx, vals = _rand_coo((3, 4), 5, seed=4)
+        t = sparse.sparse_coo_tensor(idx, vals, (3, 4),
+                                     stop_gradient=False)
+        d = paddle.to_tensor(np.ones((4, 2), np.float32),
+                             stop_gradient=False)
+        out = sparse.matmul(t, d)
+        out.sum().backward()
+        assert t.grad is not None and d.grad is not None
+        # d(sum)/d(values[i]) = sum_k dense[col_i, k] = 2 (ones, K=2)
+        np.testing.assert_allclose(_np(t.grad), np.full(5, 2.0))
+
+    def test_masked_matmul(self):
+        rs = np.random.RandomState(5)
+        a = rs.randn(4, 3).astype(np.float32)
+        b = rs.randn(3, 4).astype(np.float32)
+        idx, vals = _rand_coo((4, 4), 6, seed=6)
+        mask = sparse.sparse_coo_tensor(idx, vals, (4, 4))
+        out = sparse.masked_matmul(paddle.to_tensor(a),
+                                   paddle.to_tensor(b), mask)
+        full = a @ b
+        got = _np(out.values())
+        want = full[np.asarray(_np(out.indices()))[0],
+                    np.asarray(_np(out.indices()))[1]]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("name,fn", [
+        ("add", np.add), ("subtract", np.subtract),
+        ("multiply", np.multiply)])
+    def test_ewise(self, name, fn):
+        ia, va = _rand_coo((4, 4), 5, seed=7)
+        ib, vb = _rand_coo((4, 4), 6, seed=8)
+        a = sparse.sparse_coo_tensor(ia, va, (4, 4))
+        b = sparse.sparse_coo_tensor(ib, vb, (4, 4))
+        out = getattr(sparse, name)(a, b)
+        np.testing.assert_allclose(
+            _np(out.to_dense()), fn(_np(a.to_dense()), _np(b.to_dense())),
+            rtol=1e-5, atol=1e-6)
+
+
+class TestSparseNN:
+    def test_relu_softmax(self):
+        idx, vals = _rand_coo((4, 5), 7, seed=9)
+        coo = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+        r = sparse.nn.ReLU()(coo)
+        np.testing.assert_allclose(_np(r.values()), np.maximum(vals, 0))
+
+        csr = coo.to_sparse_csr()
+        sm = sparse.nn.Softmax()(csr)
+        dense = _np(csr.to_dense())
+        out = _np(sm.to_dense())
+        for i in range(4):
+            cols = np.nonzero(dense[i])[0]
+            if len(cols) == 0:
+                continue
+            e = np.exp(dense[i, cols] - dense[i, cols].max())
+            np.testing.assert_allclose(out[i, cols], e / e.sum(),
+                                       rtol=1e-5)
+
+    def test_batch_norm(self):
+        idx, vals = _rand_coo((2, 4, 4, 4, 3), 10, seed=10, dense_dims=1)
+        x = sparse.sparse_coo_tensor(idx, vals, (2, 4, 4, 4, 3))
+        bn = sparse.nn.BatchNorm(3)
+        out = bn(x)
+        v = _np(out.values())
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+        bn.eval()
+        out2 = bn(x)
+        assert _np(out2.values()).shape == v.shape
+
+    def test_subm_conv3d_pattern_and_values(self):
+        paddle.seed(0)
+        idx, vals = _rand_coo((1, 4, 4, 4, 2), 6, seed=11, dense_dims=1)
+        x = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 2))
+        conv = sparse.nn.SubmConv3D(2, 4, kernel_size=3, padding=1,
+                                    bias_attr=False)
+        out = conv(x)
+        # submanifold: output pattern == input pattern
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(_np(out.indices())).T.tolist(), axis=0),
+            np.sort(np.asarray(_np(x.indices())).T.tolist(), axis=0))
+        # oracle: dense conv then sample at input sites
+        import jax.numpy as jnp
+        import jax
+
+        dense = _np(x.to_dense())  # [1,4,4,4,2]
+        w = _np(conv.weight)       # [3,3,3,2,4]
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), jnp.asarray(w), (1, 1, 1), "SAME",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        oi = np.asarray(_np(out.indices()))
+        got = _np(out.values())
+        want = np.asarray(ref)[oi[0], oi[1], oi[2], oi[3]]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv3d_expands_pattern(self):
+        paddle.seed(0)
+        idx = np.array([[0], [1], [1], [1]])
+        vals = np.ones((1, 1), np.float32)
+        x = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 1))
+        conv = sparse.nn.Conv3D(1, 1, kernel_size=3, padding=1,
+                                bias_attr=False)
+        out = conv(x)
+        assert out.nnz() == 27  # 3x3x3 neighborhood all reachable
+        import jax.numpy as jnp
+        import jax
+
+        dense = _np(x.to_dense())
+        w = _np(conv.weight)
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(dense), jnp.asarray(w), (1, 1, 1), "SAME",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))
+        np.testing.assert_allclose(_np(out.to_dense()), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_maxpool3d(self):
+        idx, vals = _rand_coo((1, 4, 4, 4, 2), 9, seed=12, dense_dims=1)
+        vals = np.abs(vals)  # keep positives so dense-0 sites don't win
+        x = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 2))
+        pool = sparse.nn.MaxPool3D(2, stride=2)
+        out = pool(x)
+        dense = _np(x.to_dense())
+        ref = dense.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((2, 4, 6))
+        got = _np(out.to_dense())
+        # only compare at active output sites (sparse pool ignores
+        # all-empty windows)
+        oi = np.asarray(_np(out.indices()))
+        np.testing.assert_allclose(
+            got[oi[0], oi[1], oi[2], oi[3]],
+            ref[oi[0], oi[1], oi[2], oi[3]], rtol=1e-5)
+
+    def test_conv_grad_flows(self):
+        paddle.seed(0)
+        idx, vals = _rand_coo((1, 3, 3, 3, 2), 4, seed=13, dense_dims=1)
+        x = sparse.sparse_coo_tensor(idx, vals, (1, 3, 3, 3, 2),
+                                     stop_gradient=False)
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(x)
+        out.values().sum().backward()
+        assert conv.weight.grad is not None
+        assert np.isfinite(_np(conv.weight.grad)).all()
+
+    def test_incubate_alias(self):
+        assert paddle.incubate.sparse is paddle.sparse
+
+
+class TestSparseReviewRegressions:
+    def test_subm_conv_no_padding_boundary(self):
+        """SubmConv3D with default padding=0 must keep the input pattern
+        and produce in-bounds sites (review: boundary sites were dropped
+        and the out shape was wrong)."""
+        paddle.seed(0)
+        idx = np.array([[0], [3], [3], [3]])  # corner site
+        vals = np.ones((1, 2), np.float32)
+        x = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 2))
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, bias_attr=False)
+        out = conv(x)
+        assert out.shape == [1, 4, 4, 4, 3]
+        oi = np.asarray(_np(out.indices()))
+        np.testing.assert_array_equal(oi, idx)
+        # center-tap contribution only (corner neighbors are inactive)
+        w = _np(conv.weight)
+        want = vals @ w[1, 1, 1]
+        np.testing.assert_allclose(_np(out.values()), want, rtol=1e-5)
+
+    def test_subm_conv_rejects_stride_and_even_kernel(self):
+        with pytest.raises(ValueError):
+            idx = np.array([[0], [0], [0], [0]])
+            x = sparse.sparse_coo_tensor(idx, np.ones((1, 2), np.float32),
+                                         (1, 4, 4, 4, 2))
+            sparse.nn.SubmConv3D(2, 2, kernel_size=3, stride=2)(x)
+        with pytest.raises(ValueError):
+            idx = np.array([[0], [0], [0], [0]])
+            x = sparse.sparse_coo_tensor(idx, np.ones((1, 2), np.float32),
+                                         (1, 4, 4, 4, 2))
+            sparse.nn.SubmConv3D(2, 2, kernel_size=2)(x)
+
+    def test_maxpool_unsupported_args_raise(self):
+        with pytest.raises(NotImplementedError):
+            sparse.nn.MaxPool3D(2, return_mask=True)
+        with pytest.raises(NotImplementedError):
+            sparse.nn.MaxPool3D(2, ceil_mode=True)
+
+    def test_csr_stop_gradient_property(self):
+        t = sparse.sparse_csr_tensor([0, 1], [0], [1.0], (1, 2))
+        assert t.stop_gradient is True
+        t.stop_gradient = False
+        assert t.values().stop_gradient is False
