@@ -86,6 +86,10 @@ def matmul(x, y, name=None):
     if len(x.shape) != 2:
         raise ValueError("sparse matmul supports 2-D lhs")
     y = y if isinstance(y, Tensor) else _as_tensor(y)
+    if len(y.shape) != 2 or y.shape[0] != x.shape[1]:
+        raise ValueError(
+            f"matmul shape mismatch: sparse {x.shape} @ dense "
+            f"{list(y.shape)}")
     idx = np.asarray(unwrap(x.indices()))
     rows = jnp.asarray(idx[0])
     cols = jnp.asarray(idx[1])
@@ -108,12 +112,17 @@ def masked_matmul(x, y, mask, name=None):
         coo = mask.to_sparse_coo()
         csr_out = True
     elif isinstance(mask, SparseCooTensor):
-        coo = mask
+        coo = mask.coalesce()   # duplicate mask sites would double entries
         csr_out = False
     else:
         raise TypeError("mask must be sparse")
     x = x if isinstance(x, Tensor) else _as_tensor(x)
     y = y if isinstance(y, Tensor) else _as_tensor(y)
+    if x.shape[1] != y.shape[0] or tuple(mask.shape) != (
+            x.shape[0], y.shape[1]):
+        raise ValueError(
+            f"masked_matmul shape mismatch: x {list(x.shape)} @ y "
+            f"{list(y.shape)} sampled at mask {mask.shape}")
     idx = np.asarray(unwrap(coo.indices()))
     rows = jnp.asarray(idx[0])
     cols = jnp.asarray(idx[1])

@@ -68,7 +68,7 @@ def _conv_out_sites(idx, spatial, kernel, stride, padding, subm):
         outH = (H + 2 * ph - kh) // sh + 1
         outW = (W + 2 * pw - kw) // sw + 1
         out_spatial = (outD, outH, outW)
-        seen = {}
+        seen = set()
         out_list = []
         # enumerate reachable output sites per input site
         for s in in_sites:
@@ -87,7 +87,7 @@ def _conv_out_sites(idx, spatial, kernel, stride, padding, subm):
                             continue
                         key = (b, oz, oy, ox)
                         if key not in seen:
-                            seen[key] = len(out_list)
+                            seen.add(key)
                             out_list.append(key)
         out_sites = np.array(sorted(out_list), np.int64).reshape(-1, 4)
         table = _site_table(out_sites)
@@ -168,8 +168,9 @@ def _sparse_maxpool3d(x: SparseCooTensor, kernel, stride, padding):
 # ---------------------------------------------------------------- layers
 class ReLU(Layer):
     def forward(self, x):
-        return x._replace_values(
-            op("sparse_relu", lambda v: jnp.maximum(v, 0), [x.values()]))
+        from . import relu
+
+        return relu(x)
 
 
 class Softmax(Layer):
@@ -304,8 +305,7 @@ class SubmConv3D(_Conv3D):
 
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, groups=1, padding_mode='zeros',
-                 key=None, weight_attr=None, bias_attr=None,
-                 data_format='NDHWC'):
+                 weight_attr=None, bias_attr=None, data_format='NDHWC'):
         super().__init__(in_channels, out_channels, kernel_size, stride,
                          padding, dilation, groups, True, padding_mode,
                          weight_attr, bias_attr, data_format)

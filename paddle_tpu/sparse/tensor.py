@@ -112,6 +112,8 @@ class SparseCooTensor:
         """Sort indices row-major and sum duplicates (host-side index
         plan + on-device segment sum, like the reference's coalesce
         kernel)."""
+        if self._coalesced:
+            return self
         idx = np.asarray(unwrap(self._indices))
         flat = np.ravel_multi_index(
             tuple(idx), tuple(self._shape[:idx.shape[0]]))

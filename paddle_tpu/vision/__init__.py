@@ -3,3 +3,4 @@ from . import models
 from . import transforms
 from . import datasets
 from .models import *  # noqa: F401,F403
+from . import ops  # noqa: F401

@@ -277,3 +277,33 @@ class TestSparseReviewRegressions:
         assert t.stop_gradient is True
         t.stop_gradient = False
         assert t.values().stop_gradient is False
+
+    def test_matmul_shape_validation(self):
+        idx, vals = _rand_coo((6, 5), 4, seed=20)
+        t = sparse.sparse_coo_tensor(idx, vals, (6, 5))
+        with pytest.raises(ValueError):
+            sparse.matmul(t, paddle.to_tensor(
+                np.zeros((3, 7), np.float32)))
+        with pytest.raises(ValueError):
+            sparse.masked_matmul(
+                paddle.to_tensor(np.zeros((4, 3), np.float32)),
+                paddle.to_tensor(np.zeros((5, 4), np.float32)), t)
+
+    def test_masked_matmul_duplicate_mask(self):
+        a = np.ones((2, 2), np.float32)
+        b = np.ones((2, 2), np.float32)
+        dup_idx = np.array([[0, 0], [1, 1]])  # (0,1) twice
+        mask = sparse.sparse_coo_tensor(dup_idx, np.ones(2, np.float32),
+                                        (2, 2))
+        out = sparse.masked_matmul(paddle.to_tensor(a),
+                                   paddle.to_tensor(b), mask)
+        np.testing.assert_allclose(_np(out.to_dense())[0, 1], 2.0)
+
+    def test_relu_layer_type_error(self):
+        with pytest.raises(TypeError):
+            sparse.nn.ReLU()(paddle.to_tensor(np.zeros(3, np.float32)))
+
+    def test_coalesce_idempotent_fast_path(self):
+        idx, vals = _rand_coo((4, 4), 3, seed=21)
+        t = sparse.sparse_coo_tensor(idx, vals, (4, 4)).coalesce()
+        assert t.coalesce() is t

@@ -1,0 +1,192 @@
+"""paddle.vision.ops: roi ops, nms, deform_conv2d, yolo — against numpy
+oracles (reference unittests: test_roi_align_op, test_nms_op,
+test_deform_conv2d, test_yolo_box_op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestNMS:
+    def test_basic_suppression(self):
+        boxes = np.array([
+            [0, 0, 10, 10],
+            [1, 1, 11, 11],     # heavy overlap with box 0
+            [20, 20, 30, 30],   # disjoint
+        ], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        kept = _np(V.nms(paddle.to_tensor(boxes), 0.5,
+                         scores=paddle.to_tensor(scores)))
+        assert kept.tolist() == [0, 2]
+
+    def test_score_order_and_topk(self):
+        boxes = np.array([
+            [0, 0, 10, 10],
+            [100, 100, 110, 110],
+            [50, 50, 60, 60],
+        ], np.float32)
+        scores = np.array([0.1, 0.9, 0.5], np.float32)
+        kept = _np(V.nms(paddle.to_tensor(boxes), 0.5,
+                         scores=paddle.to_tensor(scores)))
+        assert kept.tolist() == [1, 2, 0]
+        kept2 = _np(V.nms(paddle.to_tensor(boxes), 0.5,
+                          scores=paddle.to_tensor(scores), top_k=2))
+        assert kept2.tolist() == [1, 2]
+
+    def test_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        kept = _np(V.nms(paddle.to_tensor(boxes), 0.3,
+                         scores=paddle.to_tensor(scores),
+                         category_idxs=paddle.to_tensor(cats),
+                         categories=[0, 1]))
+        assert sorted(kept.tolist()) == [0, 1]  # different cats both kept
+
+
+class TestRoIOps:
+    def test_roi_align_whole_image_identity(self):
+        # a box covering one exact pixel with output 1x1 ≈ that pixel
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[1.0, 1.0, 2.0, 2.0]], np.float32)
+        out = _np(V.roi_align(paddle.to_tensor(feat),
+                              paddle.to_tensor(boxes),
+                              paddle.to_tensor(np.array([1], np.int32)),
+                              output_size=1, sampling_ratio=1))
+        # aligned=True: center of box (1.5,1.5)-0.5=(1,1) → feat[1,1]=5
+        np.testing.assert_allclose(out.reshape(-1), [5.0], atol=1e-5)
+
+    def test_roi_align_shape_and_grad(self):
+        rs = np.random.RandomState(0)
+        feat = paddle.to_tensor(rs.randn(2, 3, 8, 8).astype(np.float32),
+                                stop_gradient=False)
+        boxes = np.array([[0, 0, 7, 7], [1, 1, 6, 6], [2, 2, 5, 5]],
+                         np.float32)
+        out = V.roi_align(feat, paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([2, 1], np.int32)),
+                          output_size=(2, 2))
+        assert tuple(out.shape) == (3, 3, 2, 2)
+        out.sum().backward()
+        assert feat.grad is not None
+
+    def test_roi_pool_max(self):
+        feat = np.zeros((1, 1, 4, 4), np.float32)
+        feat[0, 0, 1, 1] = 7.0
+        feat[0, 0, 3, 3] = 9.0
+        boxes = np.array([[0, 0, 3, 3]], np.float32)
+        out = _np(V.roi_pool(paddle.to_tensor(feat),
+                             paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1], np.int32)),
+                             output_size=2))
+        assert out.max() == 9.0 and out[0, 0, 0, 0] == 7.0
+
+    def test_psroi_pool(self):
+        rs = np.random.RandomState(1)
+        feat = rs.randn(1, 8, 6, 6).astype(np.float32)  # 8 = 2*2*2
+        boxes = np.array([[0, 0, 6, 6]], np.float32)
+        out = _np(V.psroi_pool(paddle.to_tensor(feat),
+                               paddle.to_tensor(boxes),
+                               paddle.to_tensor(np.array([1], np.int32)),
+                               output_size=2))
+        assert out.shape == (1, 2, 2, 2)
+        # bin (0,0) uses channels [0,1] rows 0-2 cols 0-2 mean
+        want = feat[0, 0, 0:3, 0:3].mean()
+        np.testing.assert_allclose(out[0, 0, 0, 0], want, rtol=1e-4)
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        import jax
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(2)
+        x = rs.randn(1, 3, 6, 6).astype(np.float32)
+        w = rs.randn(4, 3, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        out = _np(V.deform_conv2d(paddle.to_tensor(x),
+                                  paddle.to_tensor(off),
+                                  paddle.to_tensor(w), padding=1))
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_layer_with_mask_and_grad(self):
+        paddle.seed(0)
+        layer = V.DeformConv2D(3, 4, 3, padding=1)
+        rs = np.random.RandomState(3)
+        x = paddle.to_tensor(rs.randn(2, 3, 5, 5).astype(np.float32))
+        off = paddle.to_tensor(
+            0.1 * rs.randn(2, 18, 5, 5).astype(np.float32),
+            stop_gradient=False)
+        mask = paddle.to_tensor(
+            np.abs(rs.randn(2, 9, 5, 5)).astype(np.float32))
+        out = layer(x, off, mask)
+        assert tuple(out.shape) == (2, 4, 5, 5)
+        out.sum().backward()
+        assert layer.weight.grad is not None and off.grad is not None
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_range(self):
+        rs = np.random.RandomState(4)
+        N, na, cls, H, W = 2, 3, 5, 4, 4
+        x = rs.randn(N, na * (5 + cls), H, W).astype(np.float32)
+        img = np.array([[64, 64], [32, 48]], np.int32)
+        boxes, scores = V.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img),
+            anchors=[10, 13, 16, 30, 33, 23], class_num=cls,
+            conf_thresh=0.0, downsample_ratio=16)
+        assert tuple(boxes.shape) == (N, na * H * W, 4)
+        assert tuple(scores.shape) == (N, na * H * W, cls)
+        b = _np(boxes)
+        assert (b[0, :, [0, 2]] <= 64).all() and (b >= 0).all()
+        s = _np(scores)
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_yolo_loss_decreases_on_matching_pred(self):
+        # loss with a confident correct prediction < random prediction
+        rs = np.random.RandomState(5)
+        N, na, cls, H, W = 1, 3, 2, 4, 4
+        anchors = [10, 13, 16, 30, 33, 23]
+        gt_box = np.zeros((1, 2, 4), np.float32)
+        gt_box[0, 0] = [0.4, 0.4, 0.3, 0.35]  # one real box
+        gt_label = np.zeros((1, 2), np.int64)
+
+        def loss_for(xv):
+            return float(_np(V.yolo_loss(
+                paddle.to_tensor(xv), paddle.to_tensor(gt_box),
+                paddle.to_tensor(gt_label), anchors=anchors,
+                anchor_mask=[0, 1, 2], class_num=cls,
+                ignore_thresh=0.7, downsample_ratio=16,
+                use_label_smooth=False)).sum())
+
+        rand = rs.randn(N, na * (5 + cls), H, W).astype(np.float32)
+        l_rand = loss_for(rand)
+        assert np.isfinite(l_rand) and l_rand > 0
+        # gradient flows
+        xt = paddle.to_tensor(rand, stop_gradient=False)
+        loss = V.yolo_loss(xt, paddle.to_tensor(gt_box),
+                           paddle.to_tensor(gt_label), anchors=anchors,
+                           anchor_mask=[0, 1, 2], class_num=cls,
+                           ignore_thresh=0.7, downsample_ratio=16)
+        loss.sum().backward()
+        assert np.isfinite(_np(xt.grad)).all()
+
+
+class TestConvNormActivation:
+    def test_block(self):
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+
+        blk = V.ConvNormActivation(3, 8, 3)
+        x = paddle.to_tensor(
+            np.random.RandomState(6).randn(2, 3, 8, 8).astype(np.float32))
+        out = blk(x)
+        assert tuple(out.shape) == (2, 8, 8, 8)
+        assert float(out.min()) >= 0  # ReLU at the end
