@@ -3,6 +3,7 @@ re-exports the eager tape engine from core.autograd."""
 from ..core.autograd import (  # noqa: F401
     backward, grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
 )
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
-           "is_grad_enabled"]
+           "is_grad_enabled", "PyLayer", "PyLayerContext"]

@@ -1,0 +1,136 @@
+"""paddle.autograd.PyLayer — user-defined forward/backward (reference
+`python/paddle/autograd/py_layer.py`: PyLayer + PyLayerContext).
+
+TPU-native realization: `apply` runs the user's forward under `no_grad`
+(its internal ops are invisible to the tape, exactly like the reference's
+custom-op boundary) and records ONE TapeNode whose vjp is the user's
+`backward`. The backward receives/returns Tensors; the tape sees raw
+arrays, so a thin shim converts at the boundary."""
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..core.autograd import TapeNode, no_grad, is_grad_enabled
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    """`ctx` object passed to forward/backward (reference
+    PyLayerContext: save_for_backward / saved_tensor + free attrs)."""
+
+    def __init__(self):
+        self._saved: tuple = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def set_materialize_grads(self, value: bool):
+        """False: outputs that received no gradient pass None to
+        backward instead of materialized zero tensors."""
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+        if any(isinstance(b, PyLayerMeta) for b in bases) \
+                and "apply" in attrs:
+            raise RuntimeError(
+                "do not override PyLayer.apply; define forward/backward")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) and
+    backward(ctx, *grads); call via MyLayer.apply(*args)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement forward")
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement backward")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        single_out = not isinstance(outs, (tuple, list))
+        outs_list: List[Tensor] = [outs] if single_out else list(outs)
+        for o in outs_list:
+            if not isinstance(o, Tensor):
+                raise TypeError(
+                    "PyLayer.forward must return Tensor(s); got "
+                    f"{type(o).__name__}")
+
+        # Re-wrap every output in a FRESH Tensor over the same payload.
+        # Returning an input (or any tensor with live tape history)
+        # unchanged must neither clobber that tensor's _grad_node nor
+        # mutate its stop_gradient — this node owns only its own views
+        # (the reference's forward outputs are likewise new VarBases).
+        arg_ids = {id(a) for a in args if isinstance(a, Tensor)}
+        fresh: List[Tensor] = []
+        for o in outs_list:
+            if id(o) in arg_ids or o._grad_node is not None:
+                fresh.append(Tensor._wrap(o._value()))
+            else:
+                fresh.append(o)
+        outs_list = fresh
+
+        # positional Tensor inputs that want grad define the node inputs
+        # (kwargs never receive grads — matches the reference contract)
+        diff_inputs = [
+            a for a in args
+            if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if not is_grad_enabled() or not diff_inputs:
+            for o in outs_list:
+                o.stop_gradient = True
+            return outs_list[0] if single_out else tuple(outs_list)
+
+        for o in outs_list:
+            o.stop_gradient = False
+
+        def vjp_fn(cotangents):
+            cts = (cotangents,) if not isinstance(cotangents, tuple) \
+                else cotangents
+            ct_tensors = [None if c is None else Tensor._wrap(c)
+                          for c in cts]
+            with no_grad():
+                grads = cls.backward(ctx, *ct_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            n_expected = len(diff_inputs)
+            if len(grads) != n_expected:
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} "
+                    f"gradients for {n_expected} differentiable inputs")
+            out: List[Any] = []
+            for g in grads:
+                if g is None:
+                    out.append(None)
+                elif isinstance(g, Tensor):
+                    out.append(g._value())
+                else:
+                    out.append(g)
+            return tuple(out)
+
+        node = TapeNode(vjp_fn, inputs=diff_inputs, outputs=outs_list,
+                        name=cls.__name__,
+                        materialize=ctx._materialize_grads)
+        for o in outs_list:
+            o._grad_node = node
+        return outs_list[0] if single_out else tuple(outs_list)
+
+
+# reference alias (paddle 2.3 exposes both under autograd)
+LegacyPyLayer = PyLayer

@@ -64,14 +64,18 @@ class TapeNode:
     refs to differentiable input Tensors and to output Tensors (cycle is
     collected by the python GC once user refs drop)."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "released")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "released",
+                 "materialize")
 
-    def __init__(self, vjp_fn, inputs, outputs, name=""):
+    def __init__(self, vjp_fn, inputs, outputs, name="", materialize=True):
         self.vjp_fn = vjp_fn
         self.inputs: List[Any] = inputs  # Tensors (diff inputs only)
         self.outputs: List[Any] = outputs  # Tensors produced
         self.name = name
         self.released = False
+        # False (PyLayer set_materialize_grads): outputs with no incoming
+        # cotangent pass None to the vjp instead of materialized zeros
+        self.materialize = materialize
 
     def release(self):
         self.vjp_fn = None
@@ -162,7 +166,8 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
             c = cot.pop(id(out), None)
             keep.pop(id(out), None)
             if c is None:
-                c = jnp.zeros(out.shape, dtype=out.dtype)
+                if node.materialize:
+                    c = jnp.zeros(out.shape, dtype=out.dtype)
             else:
                 any_ct = True
             cts.append(c)

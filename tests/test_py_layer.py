@@ -1,0 +1,194 @@
+"""paddle.autograd.PyLayer (reference
+python/paddle/autograd/py_layer.py): custom forward/backward through the
+tape, saved tensors, multi-output, composition with regular ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestPyLayer:
+    def test_custom_backward_used(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 10  # deliberately NOT the true grad
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(_np(y), [6.0])
+        y.backward()
+        np.testing.assert_allclose(_np(x.grad), [10.0])
+
+    def test_saved_tensor_and_correct_grad(self):
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor()
+                return dy * 2 * x
+
+        x = paddle.to_tensor(np.array([2.0, -3.0], np.float32),
+                             stop_gradient=False)
+        out = Square.apply(x).sum()
+        out.backward()
+        np.testing.assert_allclose(_np(x.grad), [4.0, -6.0])
+
+    def test_composes_with_ops(self):
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.exp(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                y, = ctx.saved_tensor()
+                return dy * y
+
+        x = paddle.to_tensor(np.array([0.5], np.float32),
+                             stop_gradient=False)
+        z = (Exp.apply(x * 2) + 1).sum()
+        z.backward()
+        np.testing.assert_allclose(_np(x.grad), [2 * np.exp(1.0)],
+                                   rtol=1e-5)
+
+    def test_multi_input_output(self):
+        class AddMul(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                return a + b, a * b
+
+            @staticmethod
+            def backward(ctx, da, db):
+                # d(a+b)=da ; d(a*b) via saved inputs skipped — use shapes
+                return da + db * 3.0, da + db * 2.0
+
+        a = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        s, p = AddMul.apply(a, b)
+        (s + p).backward()
+        np.testing.assert_allclose(_np(a.grad), [4.0])
+        np.testing.assert_allclose(_np(b.grad), [3.0])
+
+    def test_wrong_grad_count_raises(self):
+        class Bad(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                return a + b
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy  # should be two grads
+
+        a = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        out = Bad.apply(a, b)
+        with pytest.raises(ValueError):
+            out.backward()
+
+    def test_apply_override_rejected(self):
+        with pytest.raises(RuntimeError):
+            class Nope(PyLayer):
+                @staticmethod
+                def forward(ctx, x):
+                    return x
+
+                @staticmethod
+                def backward(ctx, dy):
+                    return dy
+
+                @classmethod
+                def apply(cls, *a):
+                    return None
+
+    def test_stop_gradient_input(self):
+        class Ident(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 1
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))  # stop_gradient
+        y = Ident.apply(x)
+        assert y.stop_gradient
+
+    def test_passthrough_output_keeps_upstream_graph(self):
+        """Returning an input unchanged must not clobber its tape node
+        (review finding: upstream graph was silently disconnected)."""
+        class Passthrough(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = x * 2
+        z = Passthrough.apply(y)
+        (z * 1).sum().backward()
+        np.testing.assert_allclose(_np(x.grad), [2.0])
+
+    def test_no_grad_passthrough_does_not_mutate_input(self):
+        class Passthrough(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy
+
+        p = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        with paddle.no_grad():
+            out = Passthrough.apply(p)
+        assert out.stop_gradient
+        assert p.stop_gradient is False  # caller tensor untouched
+
+    def test_set_materialize_grads_false(self):
+        seen = {}
+
+        class TwoOut(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.set_materialize_grads(False)
+                return x * 1, x * 2
+
+            @staticmethod
+            def backward(ctx, d1, d2):
+                seen["d1"], seen["d2"] = d1, d2
+                g = d1 if d2 is None else d2
+                return g
+
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        a, b = TwoOut.apply(x)
+        a.sum().backward()  # b receives no gradient
+        assert seen["d2"] is None
+        assert seen["d1"] is not None
