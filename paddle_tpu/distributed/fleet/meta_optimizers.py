@@ -2,13 +2,19 @@
 meta_optimizers/ — gradient_merge_optimizer.py, lamb_optimizer.py, …).
 
 TPU notes on the reference set:
-- GradientMerge: implemented below (k-step gradient accumulation).
-- DGC (deep gradient compression) / fp16-allreduce: communication
-  compression for bandwidth-starved interconnects; on ICI the gradient
-  all-reduce is emitted fused by XLA and is not the bottleneck — not
-  implemented by design.
-- LocalSGD: relevant only across DCN; revisit with multi-pod support.
-- LARS/LAMB: plain optimizers here (optimizer/optimizer.py Lamb).
+- GradientMerge: k-step gradient accumulation (below).
+- LocalSGD: k local steps then a parameter average over the DP group
+  (below) — the DCN-friendly sync pattern; AdaptiveLocalSGD's
+  loss-derived schedule maps to the `k_steps` callable.
+- DGC: top-k gradient sparsification with residual accumulation and
+  momentum correction (below). On ICI the dense fused all-reduce is not
+  bandwidth-bound, so the win here is the *semantics* (sparse updates)
+  rather than comm compression — the reference's CUDA encode/decode
+  stages collapse into a mask.
+- fp16-allreduce: subsumed by AMP-O2 (grads are already bf16 on the
+  wire under autocast).
+- LARS/LAMB: plain optimizers (optimizer/optimizer.py Lamb).
+- ASP (2:4 structured sparsity) lives at paddle.incubate.asp.
 """
 from __future__ import annotations
 
@@ -16,7 +22,8 @@ import jax
 
 from ...core.tensor import Tensor
 
-__all__ = ["GradientMergeOptimizer"]
+__all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer",
+           "DGCMomentumOptimizer"]
 
 
 class GradientMergeOptimizer:
@@ -104,3 +111,184 @@ class GradientMergeOptimizer:
                 f"accumulation window (partial gradients were not saved)")
         self._count = 0
         self._inner.set_state_dict(state_dict)
+
+
+class LocalSGDOptimizer:
+    """LocalSGD (reference
+    `fleet/meta_optimizers/localsgd_optimizer.py:26`): run `k_steps`
+    purely-local optimizer steps, then average parameters across the
+    data-parallel group.  `k_steps` may be an int or a callable
+    `fn(step) -> int` (the Adaptive variant's schedule hook)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, group=None):
+        self._inner_opt = inner_optimizer
+        self._k = k_steps
+        self._group = group
+        self._local_steps = 0
+
+    def _cur_k(self):
+        return self._k(self._inner_opt._global_step) if callable(self._k) \
+            else int(self._k)
+
+    def step(self):
+        self._inner_opt.step()
+        self._local_steps += 1
+        if self._local_steps >= max(self._cur_k(), 1):
+            self._sync_params()
+            self._local_steps = 0
+
+    def set_state_dict(self, sd):
+        # restoring mid-window state: the local-step counter restarts
+        # (same contract as GradientMergeOptimizer)
+        self._local_steps = 0
+        return self._inner_opt.set_state_dict(sd)
+
+    def _sync_params(self):
+        """Average parameters across data-parallel workers.
+
+        Single-controller SPMD keeps params replicated on the mesh (they
+        cannot diverge), so the average is an identity — nothing to do.
+        In multi-controller mode (one process per host via
+        distributed.launch) each process owns its params and the average
+        is a cross-process mean."""
+        import jax
+        import jax.numpy as jnp
+
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        n = jax.process_count()
+        for p in self._inner_opt._parameter_list or []:
+            if not getattr(p, "trainable", True):
+                continue
+            summed = multihost_utils.process_allgather(
+                p._value()).sum(axis=0)
+            avg32 = (summed / n).astype(jnp.float32)
+            p._set_data(avg32.astype(p._value().dtype))
+            # AMP-O2: the f32 master is the next step's source of truth —
+            # refresh it too or the sync is overwritten on step()
+            accs = self._inner_opt._accumulators.get(
+                self._inner_opt._param_key(p), {})
+            mw = accs.get("master_weight")
+            if mw is not None:
+                mw._set_data(avg32)
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+
+class DGCMomentumOptimizer:
+    """Deep Gradient Compression momentum (reference
+    `fluid/optimizer.py:1540 DGCMomentumOptimizer`, arXiv:1712.01887):
+    per-parameter residual accumulators; each step the residual-corrected
+    velocity is formed, only the top-(1-sparsity) magnitude entries are
+    applied, and the rest stay local until they grow large enough."""
+
+    def __init__(self, learning_rate, momentum=0.9,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 parameters=None, use_nesterov=False, grad_clip=None,
+                 name=None):
+        from ...optimizer.optimizer import Momentum
+
+        self._inner_opt = Momentum(
+            learning_rate=learning_rate, momentum=momentum,
+            parameters=parameters, use_nesterov=use_nesterov,
+            grad_clip=grad_clip, name=name)
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(int(rampup_step), 1)
+        self._sparsity = list(sparsity)
+        self._step_count = 0
+        # paper state: u = momentum-corrected velocity, v = accumulated
+        # update awaiting transmission
+        self._u = {}
+        self._v = {}
+        self._momentum = momentum
+
+    def _cur_sparsity(self):
+        if self._step_count < self._rampup_begin:
+            return 0.0
+        i = (self._step_count - self._rampup_begin) \
+            * len(self._sparsity) // self._rampup_step
+        return self._sparsity[min(i, len(self._sparsity) - 1)]
+
+    def step(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        opt = self._inner_opt
+        sparsity = self._cur_sparsity()
+        self._step_count += 1
+        if sparsity <= 0.0:
+            opt.step()
+            return
+        params_grads = opt._collect_params_grads()
+        if opt._grad_clip is not None:
+            params_grads = opt._grad_clip(params_grads)
+        opt._global_step += 1
+        lr = opt._lr_array()
+        m = self._momentum
+        for p, g in params_grads:
+            garr = g._value() if isinstance(g, Tensor) else g
+            garr = garr.astype(jnp.float32)
+            key = opt._param_key(p)
+            u = self._u.get(key)
+            v = self._v.get(key)
+            if u is None:
+                u = jnp.zeros_like(garr)
+                v = jnp.zeros_like(garr)
+            u = m * u + garr                  # momentum correction
+            v = v + u                         # local accumulation
+            k = max(int(v.size * (1.0 - sparsity)), 1)
+            flat = jnp.abs(v).reshape(-1)
+            thresh = jax.lax.top_k(flat, k)[0][-1]
+            mask = jnp.abs(v) >= thresh
+            applied = jnp.where(mask, v, 0.0)
+            # momentum factor masking (staleness mitigation)
+            self._v[key] = jnp.where(mask, 0.0, v)
+            self._u[key] = jnp.where(mask, 0.0, u)
+            # momentum already folded into u/v: plain SGD apply
+            opt._apply_master(p, opt._master_value(p) - lr * applied)
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        """Includes the DGC residuals — at sparsity 0.999 nearly all
+        recent gradient mass lives in _v and must survive a resume."""
+        sd = self._inner_opt.state_dict()
+        for key, arr in self._u.items():
+            sd[f"@dgc_u/{key}"] = Tensor._wrap(arr)
+        for key, arr in self._v.items():
+            sd[f"@dgc_v/{key}"] = Tensor._wrap(arr)
+        sd["@dgc_step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        self._u = {}
+        self._v = {}
+        for k in list(sd):
+            if k.startswith("@dgc_u/"):
+                t = sd.pop(k)
+                self._u[k[len("@dgc_u/"):]] = (
+                    t._value() if isinstance(t, Tensor) else t)
+            elif k.startswith("@dgc_v/"):
+                t = sd.pop(k)
+                self._v[k[len("@dgc_v/"):]] = (
+                    t._value() if isinstance(t, Tensor) else t)
+        self._step_count = int(sd.pop("@dgc_step", 0))
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)

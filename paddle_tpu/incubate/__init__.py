@@ -7,5 +7,6 @@ nn composition; the ones with a real memory/layout win live in ops.fused.
 from ..ops.fused import fused_linear_cross_entropy  # noqa: F401
 from . import distributed  # noqa: F401
 from .. import sparse  # noqa: F401 — 2.3-era import path paddle.incubate.sparse
+from . import asp  # noqa: F401
 
-__all__ = ["fused_linear_cross_entropy", "distributed", "sparse"]
+__all__ = ["fused_linear_cross_entropy", "distributed", "sparse", "asp"]

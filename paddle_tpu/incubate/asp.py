@@ -1,0 +1,132 @@
+"""paddle.incubate.asp — automatic structured (2:4) sparsity (reference
+`python/paddle/incubate/asp/__init__.py` →
+`fluid/contrib/sparsity/asp.py`: prune_model, decorate,
+calculate_density, set/reset_excluded_layers).
+
+TPU note: the reference prunes for Ampere sparse-tensor-core speedups;
+the MXU has no 2:4 fast path, so here ASP is a *model-compression*
+capability — masks are computed once (magnitude-based best-2-of-4) and
+the decorated optimizer re-applies them after every step so pruned
+weights stay exactly zero through training."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    'calculate_density', 'decorate', 'prune_model',
+    'set_excluded_layers', 'reset_excluded_layers',
+]
+
+_excluded_layers = set()
+_masks = {}          # param name -> jnp bool mask
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters (by name) from pruning."""
+    for n in param_names:
+        _excluded_layers.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded_layers.clear()
+
+
+def calculate_density(x):
+    """Fraction of nonzero entries."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum()) / max(arr.size, 1)
+
+
+def _best_2in4_mask(w: np.ndarray) -> np.ndarray:
+    """2:4 mask along the last axis: keep the 2 largest |w| of every
+    contiguous group of 4 (reference sparsity/utils get_mask_2d_best /
+    create_mask with MaskAlgo.MASK_1D)."""
+    orig_shape = w.shape
+    n = w.shape[-1]
+    pad = (-n) % 4
+    if pad:
+        w = np.concatenate(
+            [w, np.zeros(w.shape[:-1] + (pad,), w.dtype)], axis=-1)
+    g = np.abs(w).reshape(-1, 4)
+    order = np.argsort(-g, axis=1)          # descending |w|
+    mask = np.zeros_like(g, dtype=bool)
+    rows = np.arange(g.shape[0])[:, None]
+    mask[rows, order[:, :2]] = True
+    mask = mask.reshape(w.shape)
+    if pad:
+        mask = mask[..., :n]
+    return mask.reshape(orig_shape)
+
+
+def _prunable(layer, p):
+    """Prune weight matrices of FC/conv layers with a sparsifiable last
+    dim, like the reference's supported-layer check."""
+    if p.name in _excluded_layers:
+        return False
+    if getattr(p, "is_bias", False) or p.ndim < 2:
+        return False
+    return p.shape[-1] >= 4
+
+
+def prune_model(model, n=2, m=4, mask_algo='mask_1d', with_mask=True):
+    """Compute and apply n:m masks to every prunable parameter of
+    `model`; returns {param_name: mask Tensor}."""
+    if (n, m) != (2, 4):
+        raise NotImplementedError("only 2:4 sparsity is supported")
+    out = {}
+    for layer in model.sublayers(include_self=True):
+        for pname, p in layer.named_parameters(include_sublayers=False):
+            if not _prunable(layer, p):
+                continue
+            w = np.asarray(p.numpy(), np.float32)
+            mask = _best_2in4_mask(w)
+            key = p.name or f"param_{id(p)}"
+            _masks[key] = jnp.asarray(mask)
+            p._set_data(p._value() * jnp.asarray(mask, p._value().dtype))
+            out[key] = Tensor._wrap(jnp.asarray(mask))
+    return out
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wraps an optimizer so masks survive updates (reference
+    `asp.py OptimizerWithSparsityGuarantee`)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def step(self):
+        self._inner_opt.step()
+        for p in self._inner_opt._parameter_list or []:
+            key = p.name or f"param_{id(p)}"
+            mask = _masks.get(key)
+            if mask is not None:
+                arr = p._value()
+                p._set_data(arr * mask.astype(arr.dtype))
+                # keep the f32 master consistent too (AMP-O2)
+                accs = self._inner_opt._accumulators.get(
+                    self._inner_opt._param_key(p), {})
+                mw = accs.get("master_weight")
+                if mw is not None:
+                    mw._set_data(mw._value()
+                                 * mask.astype(mw._value().dtype))
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)
