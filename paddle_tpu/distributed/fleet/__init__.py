@@ -107,6 +107,16 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
 
         cfg = s.gradient_merge_configs
         opt = GradientMergeOptimizer(opt, k_steps=cfg.k_steps, avg=cfg.avg)
+    if s is not None and getattr(s, "localsgd", False):
+        from .meta_optimizers import LocalSGDOptimizer
+
+        cfg = s.localsgd_configs
+        opt = LocalSGDOptimizer(opt, k_steps=cfg.k_steps)
+    if s is not None and getattr(s, "dgc", False):
+        raise ValueError(
+            "strategy.dgc: construct DGCMomentumOptimizer directly (it "
+            "replaces the inner momentum optimizer rather than wrapping "
+            "an arbitrary one, matching the reference DGC contract)")
     return opt
 
 

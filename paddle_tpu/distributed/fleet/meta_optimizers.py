@@ -162,17 +162,18 @@ class LocalSGDOptimizer:
         for p in self._inner_opt._parameter_list or []:
             if not getattr(p, "trainable", True):
                 continue
-            summed = multihost_utils.process_allgather(
-                p._value()).sum(axis=0)
+            # average the f32 source of truth (the master under AMP-O2,
+            # else the param itself) so sub-bf16-resolution fractions
+            # survive the sync; params get the cast-down view
+            src32 = self._inner_opt._master_value(p)
+            summed = multihost_utils.process_allgather(src32).sum(axis=0)
             avg32 = (summed / n).astype(jnp.float32)
-            p._set_data(avg32.astype(p._value().dtype))
-            # AMP-O2: the f32 master is the next step's source of truth —
-            # refresh it too or the sync is overwritten on step()
             accs = self._inner_opt._accumulators.get(
                 self._inner_opt._param_key(p), {})
             mw = accs.get("master_weight")
             if mw is not None:
                 mw._set_data(avg32)
+            p._set_data(avg32.astype(p._value().dtype))
 
     def clear_grad(self, *a, **k):
         return self._inner_opt.clear_grad(*a, **k)
@@ -243,7 +244,12 @@ class DGCMomentumOptimizer:
             u = self._u.get(key)
             v = self._v.get(key)
             if u is None:
-                u = jnp.zeros_like(garr)
+                # seed from the warmup phase's Momentum velocity so the
+                # dense->sparse transition keeps its history (the
+                # reference dgc_momentum op shares one velocity)
+                vel = opt._accumulators.get(key, {}).pop("velocity", None)
+                u = vel._value().astype(jnp.float32) if vel is not None \
+                    else jnp.zeros_like(garr)
                 v = jnp.zeros_like(garr)
             u = m * u + garr                  # momentum correction
             v = v + u                         # local accumulation
@@ -263,30 +269,40 @@ class DGCMomentumOptimizer:
 
     clear_gradients = clear_grad
 
+    def _param_order(self):
+        """Positional identity for residual keys: saved and restored runs
+        may auto-name params differently (the inner optimizer remaps its
+        accumulators the same way)."""
+        return [self._inner_opt._param_key(p)
+                for p in self._inner_opt._parameter_list or []]
+
     def state_dict(self):
         """Includes the DGC residuals — at sparsity 0.999 nearly all
-        recent gradient mass lives in _v and must survive a resume."""
+        recent gradient mass lives in _v and must survive a resume.
+        Residuals are saved by PARAMETER POSITION, not name."""
         sd = self._inner_opt.state_dict()
-        for key, arr in self._u.items():
-            sd[f"@dgc_u/{key}"] = Tensor._wrap(arr)
-        for key, arr in self._v.items():
-            sd[f"@dgc_v/{key}"] = Tensor._wrap(arr)
+        for i, key in enumerate(self._param_order()):
+            if key in self._u:
+                sd[f"@dgc_u/{i}"] = Tensor._wrap(self._u[key])
+            if key in self._v:
+                sd[f"@dgc_v/{i}"] = Tensor._wrap(self._v[key])
         sd["@dgc_step"] = self._step_count
         return sd
 
     def set_state_dict(self, sd):
         sd = dict(sd)
+        order = self._param_order()
         self._u = {}
         self._v = {}
         for k in list(sd):
-            if k.startswith("@dgc_u/"):
-                t = sd.pop(k)
-                self._u[k[len("@dgc_u/"):]] = (
-                    t._value() if isinstance(t, Tensor) else t)
-            elif k.startswith("@dgc_v/"):
-                t = sd.pop(k)
-                self._v[k[len("@dgc_v/"):]] = (
-                    t._value() if isinstance(t, Tensor) else t)
+            for prefix, store in (("@dgc_u/", self._u),
+                                  ("@dgc_v/", self._v)):
+                if k.startswith(prefix):
+                    t = sd.pop(k)
+                    i = int(k[len(prefix):])
+                    if i < len(order):
+                        store[order[i]] = (
+                            t._value() if isinstance(t, Tensor) else t)
         self._step_count = int(sd.pop("@dgc_step", 0))
         return self._inner_opt.set_state_dict(sd)
 

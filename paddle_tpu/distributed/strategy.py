@@ -64,6 +64,19 @@ class GradientMergeConfig:
 
 
 @dataclass
+class LocalSGDConfig:
+    k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclass
+class DGCConfig:
+    rampup_begin_step: int = 0
+    rampup_step: int = 1
+    sparsity: tuple = (0.999,)
+
+
+@dataclass
 class MoEConfig:
     enable: bool = False
     num_experts: int = 1
@@ -87,11 +100,15 @@ class DistributedStrategy:
         self.sharding_configs = ShardingConfig()
         self.pipeline_configs = PipelineConfig()
         self.gradient_merge_configs = GradientMergeConfig()
+        self.localsgd_configs = LocalSGDConfig()
+        self.dgc_configs = DGCConfig()
         self.moe_configs = MoEConfig()
         self.amp = False
         self.recompute = False
         self.sharding = False
         self.gradient_merge = False
+        self.localsgd = False
+        self.dgc = False
         self.find_unused_parameters = False
 
     def __setattr__(self, name, value):

@@ -61,12 +61,26 @@ def _best_2in4_mask(w: np.ndarray) -> np.ndarray:
     return mask.reshape(orig_shape)
 
 
+def _supported_layer(layer):
+    from .. import nn
+
+    types = [nn.Linear]
+    for name in ("Conv1D", "Conv2D", "Conv3D"):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            types.append(cls)
+    return isinstance(layer, tuple(types))
+
+
 def _prunable(layer, p):
     """Prune weight matrices of FC/conv layers with a sparsifiable last
-    dim, like the reference's supported-layer check."""
+    dim (reference supported-layers check — embeddings, norms and biases
+    are never pruned)."""
     if p.name in _excluded_layers:
         return False
-    if getattr(p, "is_bias", False) or p.ndim < 2:
+    if not _supported_layer(layer):
+        return False
+    if p.ndim < 2:         # biases and norm scales
         return False
     return p.shape[-1] >= 4
 

@@ -1,0 +1,69 @@
+"""New vision model families: forward shape + a train step each
+(reference: python/paddle/vision/models/{squeezenet,densenet,
+shufflenetv2,googlenet,inceptionv3,mobilenetv3}.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _x(n=1, s=64):
+    rs = np.random.RandomState(0)
+    return paddle.to_tensor(rs.randn(n, 3, s, s).astype(np.float32))
+
+
+@pytest.mark.parametrize("name,make,kw", [
+    ("squeezenet1_0", M.squeezenet1_0, {}),
+    ("squeezenet1_1", M.squeezenet1_1, {}),
+    ("densenet121", M.densenet121, {}),
+    ("shufflenet_v2_x0_25", M.shufflenet_v2_x0_25, {}),
+    ("mobilenet_v3_small", M.mobilenet_v3_small, {}),
+])
+def test_forward_shapes(name, make, kw):
+    paddle.seed(0)
+    net = make(num_classes=10, **kw)
+    net.eval()
+    out = net(_x())
+    assert tuple(out.shape) == (1, 10), name
+
+
+@pytest.mark.slow
+def test_googlenet_aux_heads():
+    paddle.seed(0)
+    net = M.googlenet(num_classes=10)
+    net.eval()
+    out, aux1, aux2 = net(_x())
+    assert tuple(out.shape) == (1, 10)
+    assert tuple(aux1.shape) == (1, 10)
+    assert tuple(aux2.shape) == (1, 10)
+
+
+@pytest.mark.slow
+def test_inception_v3_forward():
+    paddle.seed(0)
+    net = M.inception_v3(num_classes=10)
+    net.eval()
+    out = net(_x(s=96))   # reduced input for test speed
+    assert tuple(out.shape) == (1, 10)
+
+
+def test_train_step_squeezenet():
+    paddle.seed(0)
+    net = M.squeezenet1_1(num_classes=4)
+    opt = paddle.optimizer.SGD(0.01, parameters=net.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    l0 = None
+    for _ in range(3):
+        loss = ce(net(_x(n=2)), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        l0 = l0 if l0 is not None else float(loss)
+    assert float(loss) < l0 + 1e-6
+
+
+def test_pretrained_raises():
+    with pytest.raises(NotImplementedError):
+        M.densenet121(pretrained=True)
