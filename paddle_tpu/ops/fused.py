@@ -120,7 +120,7 @@ _flce.defvjp(_flce_fwd, _flce_bwd)
 
 
 def fused_linear_cross_entropy(hidden, weight, label, loss_mask=None,
-                               ignore_index: int = -100, block_size: int = 2048,
+                               ignore_index: int = -100, block_size=None,
                                transpose_weight: bool = False, name=None):
     """Causal-LM loss `cross_entropy(hidden @ weight.T, label)` without ever
     materializing the [..., vocab] logits (see module docstring).
@@ -132,9 +132,15 @@ def fused_linear_cross_entropy(hidden, weight, label, loss_mask=None,
         label: [...] int token ids; ``ignore_index`` positions contribute 0
             loss and 0 gradient.
         loss_mask: optional [...] multiplicative mask.
+        block_size: vocab tile width; None reads PADDLE_TPU_FLCE_BLOCK
+            (default 2048) so the bench can sweep without code changes.
     Returns:
         scalar mean loss over non-ignored (and mask-weighted) positions.
     """
+    if block_size is None:
+        import os
+
+        block_size = int(os.environ.get("PADDLE_TPU_FLCE_BLOCK", "2048"))
 
     def _primal(h, w, lbl, *maybe_mask):
         if transpose_weight:

@@ -17,3 +17,110 @@ def try_import(module_name: str):
     except ImportError as e:
         raise ImportError(
             f"Failed to import {module_name}: {e}") from e
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """Decorator marking an API deprecated (reference
+    paddle.utils.deprecated): warns on call, appends a note to the
+    docstring."""
+    import functools
+    import warnings
+
+    def decorator(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        fn.__doc__ = (fn.__doc__ or "") + f"\n\n.. deprecated:: {msg}\n"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                # reference semantics: level 2 means the API is removed
+                raise RuntimeError(msg)
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def run_check():
+    """Sanity-check the install (reference
+    paddle.utils.install_check.run_check): runs a tiny train step on the
+    attached device and reports."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    from .. import nn
+
+    dev = jax.devices()[0]
+    print(f"Running verify on {dev.platform} device: {dev.device_kind} ...")
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((8, 1), np.float32))
+    for _ in range(3):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(float(loss)), "train step produced non-finite loss"
+    print("paddle_tpu is installed successfully! Let's start deep "
+          "learning with paddle_tpu now.")
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._ids = {}
+
+    def __call__(self, key):
+        i = self._ids.get(key, 0)
+        self._ids[key] = i + 1
+        return f"{key}_{i}"
+
+
+_unique_name_gen = _UniqueNameGenerator()
+
+
+class unique_name:
+    """Reference paddle.utils.unique_name: generate/guard unique names."""
+
+    @staticmethod
+    def generate(key):
+        return _unique_name_gen(key)
+
+    @staticmethod
+    def guard(new_generator=None):
+        """Scope a fresh name space. `new_generator` may be a string
+        prefix (reference behavior) or a custom generator callable."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            global _unique_name_gen
+            old = _unique_name_gen
+            if callable(new_generator):
+                _unique_name_gen = new_generator
+            elif isinstance(new_generator, str):
+                prefix = new_generator
+                inner = _UniqueNameGenerator()
+                _unique_name_gen = lambda key: prefix + inner(key)
+            else:
+                _unique_name_gen = _UniqueNameGenerator()
+            try:
+                yield
+            finally:
+                _unique_name_gen = old
+
+        return _guard()
+
+
+__all__ += ["try_import", "deprecated", "run_check", "unique_name"]

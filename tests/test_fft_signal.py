@@ -420,3 +420,52 @@ class TestReviewRegressions2:
         assert paddle.hub.load(str(tmp_path), "f") == 1  # cached
         assert paddle.hub.load(str(tmp_path), "f",
                                force_reload=True) == 2
+
+
+class TestUtilsParity:
+    def test_deprecated_warns(self):
+        import warnings
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(update_to="paddle.new_api", since="0.3")
+        def old_api():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_api() == 42
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+        assert "deprecated" in (old_api.__doc__ or "")
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        out = capsys.readouterr().out
+        assert "successfully" in out
+
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b
+        with unique_name.guard():
+            c = unique_name.generate("fc")
+            assert c == "fc_0"
+
+    def test_deprecated_level2_raises(self):
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(level=2)
+        def removed_api():
+            return 1
+
+        with pytest.raises(RuntimeError):
+            removed_api()
+
+    def test_unique_name_guard_prefix(self):
+        from paddle_tpu.utils import unique_name
+
+        with unique_name.guard("blockA_"):
+            assert unique_name.generate("fc") == "blockA_fc_0"
+        with unique_name.guard(lambda key: f"custom::{key}"):
+            assert unique_name.generate("fc") == "custom::fc"

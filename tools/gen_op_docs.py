@@ -1,0 +1,62 @@
+"""Generate docs/ops.md from the op-schema table (the third leg of the
+reference's api.yaml codegen triad: schema -> API + tests + DOCS —
+`python/paddle/utils/code_gen/api_gen.py` generates docs stubs from the
+same YAML that generates the C++ API; here tests/test_op_suite.py's
+SPECS table is the single source of truth)."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import inspect
+
+    import paddle_tpu as paddle
+    from test_op_suite import SPECS
+
+    lines = [
+        "# paddle_tpu op reference",
+        "",
+        "Generated from the op-schema table (`tests/test_op_suite.py` "
+        "SPECS) by `tools/gen_op_docs.py` — the same rows drive the "
+        "OpTest harness (forward vs numpy oracle, analytic-vs-numeric "
+        "gradients, dtype sweeps, Tensor-method binding).",
+        "",
+        f"**{len(SPECS)} ops enrolled.**",
+        "",
+        "| op | signature | grad-checked | dtypes | Tensor method |",
+        "|---|---|---|---|---|",
+    ]
+    for spec in sorted(SPECS, key=lambda s: s.name):
+        fn = spec.fn or getattr(paddle, spec.name, None)
+        try:
+            sig = str(inspect.signature(fn)) if fn is not None else "?"
+        except (TypeError, ValueError):
+            sig = "(...)"
+        if len(sig) > 60:
+            sig = sig[:57] + "..."
+        dtypes = ", ".join(spec.dtypes)
+        method = f"`.{spec.method}()`" if spec.method else "—"
+        grad = "yes" if spec.grad else "no"
+        lines.append(
+            f"| `{spec.name}` | `{sig}` | {grad} | {dtypes} | {method} |")
+    lines.append("")
+
+    out = os.path.join(os.path.dirname(__file__), "..", "docs", "ops.md")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {out}: {len(SPECS)} ops")
+
+
+if __name__ == "__main__":
+    main()
