@@ -46,8 +46,9 @@ from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabl
 from .core.rng import seed, get_rng_state, set_rng_state, Generator
 from .core.flags import get_flags, set_flags, define_flag
 from .core import device
-from .core.device import (
+from .core.device import (  # noqa: F401
     set_device, get_device, is_compiled_with_tpu, CPUPlace, TPUPlace, Place,
+    CUDAPlace, CUDAPinnedPlace, NPUPlace,
 )
 
 from .ops import *  # noqa: F401,F403 — the paddle.* op surface
@@ -158,6 +159,57 @@ def iinfo(dtype):
     out.max = int(info.max)
     out.bits = int(info.bits)
     return out
+
+
+# reference top-level odds and ends ---------------------------------------
+from .nn.layer_base import ParamAttr  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+
+# dtype aliases exported at top level (paddle.bool etc. come from core.dtype
+# via the star import; `dtype` is the metatype name in the reference pybind)
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype   # the metatype: isinstance(x.dtype, paddle.dtype)
+bool = _dtype_mod.convert_dtype("bool")  # noqa: A001
+
+
+def reverse(x, axis, name=None):
+    """Reference paddle.reverse (fluid-era alias of flip)."""
+    from .ops.manipulation import flip
+
+    return flip(x, axis)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Top-level parameter factory (reference
+    python/paddle/tensor/creation.py create_parameter)."""
+    from .nn import layer_base
+
+    helper = layer_base.Layer()
+    p = helper.create_parameter(shape, attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+def disable_signal_handler():
+    """Reference parity no-op: paddle installs C++ signal handlers that
+    this build never installs (XLA/jax own the runtime)."""
+
+
+def get_cuda_rng_state():
+    """CUDA RNG surface: no CUDA in the TPU build — empty state list
+    (shape-compatible with reference callers that save/restore it)."""
+    return []
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        raise RuntimeError(
+            "set_cuda_rng_state: no CUDA devices in the TPU build")
 
 
 def finfo(dtype):

@@ -208,3 +208,23 @@ tpu = _AcceleratorNamespace()
 # source compatibility for reference code reaching for .cuda on an
 # accelerator: same counters, backed by the TPU/PJRT allocator
 cuda = tpu
+
+
+class CUDAPlace(Place):
+    """API-compat CUDA place (reference phi/common/place.h GPUPlace).
+    This build targets TPU via XLA; constructing one is allowed (so
+    ported code parses), and placing tensors on it fails in device
+    resolution with the standard no-gpu-devices error."""
+
+    def __init__(self, device_id=0):
+        super().__init__("gpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    def __init__(self):
+        super().__init__("gpu_pinned", 0)
+
+
+class NPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("npu", device_id)

@@ -44,6 +44,7 @@ from .layer.rnn import (
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,
 )
+from .layer.decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer.transformer import (
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

@@ -1,0 +1,254 @@
+"""Seq2seq decoding: Decoder / BeamSearchDecoder / dynamic_decode
+(reference `python/paddle/fluid/layers/rnn.py:758,871,1598`, re-exported
+at `paddle.nn`).
+
+TPU-native notes: the decode loop runs eagerly over compiled step ops
+(each step is one XLA program via the tape); beam bookkeeping is plain
+jnp gather/top_k. The final backtrace reuses
+`nn.functional.gather_tree`."""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import op, unwrap, wrap
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decode contract (reference rnn.py:758):
+    initialize() -> (inputs, states, finished);
+    step(time, inputs, states) -> (outputs, states, next_inputs, finished);
+    optional finalize()."""
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference rnn.py:871).
+
+    cell: an RNNCellBase-like layer `cell(inputs, states) -> (out, states)`
+    embedding_fn: token ids -> embeddings for the next step's inputs
+    output_fn: projects cell output to vocab logits
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam/batch reshaping helpers (reference :930-1010) -------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] by tiling each row."""
+
+        def _primal(a):
+            expanded = jnp.repeat(a[:, None], beam_size, axis=1)
+            return expanded.reshape((-1,) + a.shape[1:])
+
+        return op("tile_beam_merge", _primal, [x])
+
+    def _map_states(self, states, fn):
+        if isinstance(states, (list, tuple)):
+            return type(states)(self._map_states(s, fn) for s in states)
+        return fn(states)
+
+    # -- contract -------------------------------------------------------
+    def initialize(self, initial_cell_states):
+        cell_states = self._map_states(
+            initial_cell_states,
+            lambda t: self.tile_beam_merge_with_batch(t, self.beam_size))
+        first = initial_cell_states
+        while isinstance(first, (list, tuple)):
+            first = first[0]
+        batch = first.shape[0]
+        self._batch_size = batch
+        # beam 0 active, others -inf so step 1 fans out from one beam
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32)[None], (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int32)
+        tokens = jnp.full((batch * self.beam_size,), self.start_token,
+                          jnp.int32)
+        inputs = wrap(tokens)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        states = self.StateWrapper(cell_states, wrap(log_probs),
+                                   wrap(finished), wrap(lengths))
+        return inputs, states, wrap(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_cell_states = self.cell(inputs, states.cell_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = unwrap(cell_out)                    # [B*beam, V]
+        V = logits.shape[-1]
+        B = self._batch_size
+        K = self.beam_size
+        log_probs_prev = unwrap(states.log_probs)    # [B, K]
+        finished = unwrap(states.finished)           # [B, K]
+        lengths = unwrap(states.lengths)             # [B, K]
+
+        step_lp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
+        # finished beams may only emit end_token (with log-prob 0) so
+        # their total score freezes
+        eos_only = jnp.full((V,), -1e9, jnp.float32).at[
+            self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, :, None], eos_only[None, None],
+                            step_lp)
+        total = log_probs_prev[:, :, None] + step_lp     # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)      # [B, K]
+        parent = (top_idx // V).astype(jnp.int32)
+        token = (top_idx % V).astype(jnp.int32)
+
+        batch_ix = jnp.arange(B)[:, None]
+        prev_fin = finished[batch_ix, parent]
+        new_fin = prev_fin | (token == self.end_token)
+        new_len = lengths[batch_ix, parent] + (~prev_fin).astype(jnp.int32)
+
+        # reorder cell states by parent beam
+        flat_parent = (parent + jnp.arange(B)[:, None] * K).reshape(-1)
+
+        def _reorder(t):
+            arr = unwrap(t)
+            return wrap(arr[flat_parent])
+
+        next_cell_states = self._map_states(next_cell_states, _reorder)
+
+        out = self.OutputWrapper(wrap(top_scores), wrap(token),
+                                 wrap(parent))
+        next_states = self.StateWrapper(next_cell_states,
+                                        wrap(top_scores), wrap(new_fin),
+                                        wrap(new_len))
+        next_inputs = wrap(token.reshape(-1))
+        if self.embedding_fn is not None:
+            next_inputs = self.embedding_fn(next_inputs)
+        return out, next_states, next_inputs, wrap(new_fin)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace beams to token sequences via gather_tree."""
+        from ..functional.extras import gather_tree
+
+        # outputs.*: [T, B, K]
+        ids = outputs.predicted_ids
+        parents = outputs.parent_ids
+        seqs = gather_tree(ids, parents)
+        return seqs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run decoder.step until all beams finish or max_step_num
+    (reference rnn.py:1598). Eager loop; each step is one compiled
+    program. `is_test` is accepted for signature parity — it selects the
+    reference's cached-inference program path, which has no analog here
+    (every step is already a cached XLA executable)."""
+    inputs, states, finished = decoder.initialize(inits)
+    finished_arr = np.asarray(unwrap(finished)).astype(bool)
+    step_outputs = []
+    time = 0
+    max_steps = max_step_num if max_step_num is not None else 256
+    while time < max_steps and not finished_arr.all():
+        prev_finished = finished_arr
+        out, states, inputs, step_finished = decoder.step(
+            time, inputs, states, **kwargs)
+        sf = np.asarray(unwrap(step_finished)).astype(bool)
+        # reference rnn.py:1598 contract: unless the decoder tracks its
+        # own finished set, a finished beam stays finished
+        finished_arr = sf if decoder.tracks_own_finished \
+            else (prev_finished | sf)
+        if impute_finished and prev_finished.any():
+            # freeze emissions of beams that were already finished
+            def _impute(t):
+                arr = unwrap(t)
+                mask = prev_finished.reshape(
+                    prev_finished.shape + (1,) * (arr.ndim
+                                                  - prev_finished.ndim))
+                return wrap(jnp.where(jnp.asarray(mask),
+                                      jnp.zeros_like(arr), arr))
+
+            if hasattr(out, "_fields"):
+                out = type(out)(*[_impute(getattr(out, f))
+                                  for f in out._fields])
+            else:
+                out = _impute(out)
+        step_outputs.append(out)
+        time += 1
+
+    if not step_outputs:
+        seq_lengths = getattr(states, "lengths", None)
+        if return_length:
+            return None, states, seq_lengths
+        return None, states
+
+    # stack along time
+    def _stack(field):
+        return wrap(jnp.stack([unwrap(getattr(o, field))
+                               for o in step_outputs], axis=0))
+
+    if isinstance(step_outputs[0], tuple) and hasattr(step_outputs[0],
+                                                      "_fields"):
+        stacked = type(step_outputs[0])(
+            *[_stack(f) for f in step_outputs[0]._fields])
+    else:
+        stacked = wrap(jnp.stack([unwrap(o) for o in step_outputs],
+                                 axis=0))
+
+    seq_lengths = getattr(states, "lengths", None)
+    if hasattr(decoder, "finalize") and type(decoder).finalize \
+            is not Decoder.finalize:
+        outputs, final_states = decoder.finalize(stacked, states,
+                                                 seq_lengths)
+    else:
+        outputs, final_states = stacked, states
+
+    def _to_batch_major(t):
+        arr = unwrap(t)
+        if arr.ndim >= 2:
+            return wrap(jnp.swapaxes(arr, 0, 1))
+        return t
+
+    if not output_time_major:
+        if isinstance(outputs, tuple) and hasattr(outputs, "_fields"):
+            outputs = type(outputs)(
+                *[_to_batch_major(getattr(outputs, f))
+                  for f in outputs._fields])
+        elif isinstance(outputs, Tensor):
+            outputs = _to_batch_major(outputs)
+    if return_length:
+        return outputs, final_states, seq_lengths
+    return outputs, final_states

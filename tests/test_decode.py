@@ -1,0 +1,126 @@
+"""BeamSearchDecoder + dynamic_decode (reference
+fluid/layers/rnn.py:871,1598)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class _DeterministicCell(nn.Layer):
+    """Cell whose logits depend only on the input token: token t ->
+    prefers token (t+1) % V, and V-1 is the end token."""
+
+    def __init__(self, vocab):
+        super().__init__()
+        self.vocab = vocab
+        # build a fixed logit table favoring next-token
+        import jax.numpy as jnp
+
+        tbl = np.full((vocab, vocab), -5.0, np.float32)
+        for t in range(vocab):
+            tbl[t, (t + 1) % vocab] = 5.0
+        self._tbl = paddle.to_tensor(tbl)
+
+    def forward(self, inputs, states):
+        # inputs: token ids [B*K]; states: dummy [B*K, 1]
+        logits = paddle.index_select(self._tbl, inputs, axis=0)
+        return logits, states
+
+
+class TestBeamSearch:
+    def test_greedy_path_found(self):
+        V, B, K = 6, 2, 3
+        cell = _DeterministicCell(V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                                   beam_size=K)
+        init_state = paddle.to_tensor(np.zeros((B, 1), np.float32))
+        outputs, final_states = nn.dynamic_decode(dec, inits=init_state,
+                                                  max_step_num=10)
+        ids = _np(outputs)      # [B, T, K] batch-major (backtraced)
+        # top beam must follow 1,2,3,4,5 (5 = end token), then pad with
+        # the end token while other beams keep exploring
+        np.testing.assert_array_equal(ids[0, :5, 0], [1, 2, 3, 4, 5])
+        np.testing.assert_array_equal(ids[1, :5, 0], [1, 2, 3, 4, 5])
+        assert (ids[0, 5:, 0] == 5).all()
+
+    def test_finished_beams_freeze(self):
+        V, B, K = 4, 1, 2
+        cell = _DeterministicCell(V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=3,
+                                   beam_size=K)
+        init_state = paddle.to_tensor(np.zeros((B, 1), np.float32))
+        outputs, states, lengths = nn.dynamic_decode(
+            dec, inits=init_state, max_step_num=8, return_length=True)
+        # path 1,2,3 ends at step 3: length 3
+        assert int(_np(lengths)[0, 0]) == 3
+
+    def test_tile_beam_merge(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 3)
+        assert tuple(t.shape) == (6, 2)
+        np.testing.assert_array_equal(_np(t)[0], _np(t)[1])
+
+    def test_time_major_output(self):
+        V, B, K = 4, 1, 2
+        cell = _DeterministicCell(V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=3,
+                                   beam_size=K)
+        init_state = paddle.to_tensor(np.zeros((B, 1), np.float32))
+        out_tm, _ = nn.dynamic_decode(dec, inits=init_state,
+                                      max_step_num=8,
+                                      output_time_major=True)
+        out_bm, _ = nn.dynamic_decode(dec, inits=init_state,
+                                      max_step_num=8)
+        assert out_tm.shape[0] == out_bm.shape[1]
+
+
+class TestReviewRegressions:
+    def test_dtype_metatype(self):
+        assert isinstance(paddle.int64, paddle.dtype)
+        assert isinstance(paddle.float32, paddle.dtype)
+        assert isinstance(paddle.bool, paddle.dtype)
+
+    def test_max_step_zero(self):
+        cell = _DeterministicCell(4)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=3,
+                                   beam_size=2)
+        init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+        out, states = nn.dynamic_decode(dec, inits=init, max_step_num=0)
+        assert out is None
+
+    def test_finished_accumulates_for_plain_decoder(self):
+        """A decoder with tracks_own_finished=False reporting per-step
+        finish must stay finished (OR semantics)."""
+        calls = []
+
+        class Flaky(nn.Decoder):
+            def initialize(self, inits):
+                z = paddle.to_tensor(np.zeros((1, 1), np.float32))
+                return z, z, paddle.to_tensor(
+                    np.array([[False]]))
+
+            def step(self, time, inputs, states, **kw):
+                calls.append(time)
+                # finished only on step 0, False afterwards
+                fin = paddle.to_tensor(np.array([[time == 0]]))
+                return inputs, states, inputs, fin
+
+        out, states = nn.dynamic_decode(Flaky(), max_step_num=10)
+        assert calls == [0]  # finished latched after step 0
+
+    def test_no_int64_warnings(self):
+        import warnings
+
+        cell = _DeterministicCell(4)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=3,
+                                   beam_size=2)
+        init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            nn.dynamic_decode(dec, inits=init, max_step_num=4)
+        assert not [x for x in w if "int64" in str(x.message)]
