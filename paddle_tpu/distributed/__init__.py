@@ -32,3 +32,56 @@ def spawn(func=None, args=(), nprocs=-1, **kwargs):
         "single-controller SPMD has no per-rank process spawn; one python "
         "process drives every chip — call the function directly (use "
         "paddle_tpu.distributed.launch for multi-host jobs)")
+from .fleet.topology import ParallelMode  # noqa: E402,F401
+from . import launch  # noqa: E402,F401 — python -m paddle_tpu.distributed.launch
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference collective.wait: block until `tensor`'s producing work
+    completes. XLA dispatch is async; forcing the payload is the analog."""
+    import jax
+
+    jax.block_until_ready(tensor._value())
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference auto-TP `paddle.distributed.split` (collective.py:1557):
+    build the {embedding, linear} layer with its weight partitioned over
+    the model-parallel group. On TPU the same capability is the
+    {Vocab,Column,Row}ParallelLinear/Embedding layers whose weights carry
+    GSPMD shardings — construct those instead."""
+    from .fleet.meta_parallel.parallel_layers import mp_layers
+
+    if operation == "embedding":
+        return mp_layers.VocabParallelEmbedding(
+            size[0], size[1], weight_attr=weight_attr)
+    if operation == "linear":
+        if axis == 0:
+            return mp_layers.RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=not gather_out)
+        return mp_layers.ColumnParallelLinear(
+            size[0], size[1], weight_attr=weight_attr,
+            has_bias=bias_attr is not False, gather_output=gather_out)
+    raise ValueError(f"split: unsupported operation {operation!r} "
+                     "(embedding/linear)")
+
+
+# gloo compatibility surface: the reference uses gloo for CPU barriers
+# during init; jax's coordination service owns that role here.
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """No-op (reference parallel.py gloo bootstrap): multi-controller
+    rendezvous is jax.distributed.initialize, wired by
+    distributed.launch."""
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """No-op: no gloo resources to release."""

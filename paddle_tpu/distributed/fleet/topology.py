@@ -194,3 +194,13 @@ def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
     return _hcg
+
+
+class ParallelMode:
+    """Reference enum (fleet/base/topology.py:29): integer constants
+    naming the hybrid-parallel mode of the current group."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3

@@ -73,7 +73,7 @@ class StaticFunction:
         return tuple(bits)
 
     def __call__(self, *args, **kwargs):
-        if not self._enabled:
+        if not self._enabled or not ProgramTranslator.enable_to_static:
             return self._fn(*args, **kwargs)
         leaves: List[Tensor] = []
         args_tree = _flatten_io(list(args), leaves)
@@ -149,14 +149,6 @@ declarative = to_static
 def not_to_static(fn):
     fn._not_to_static = True
     return fn
-
-
-def enable_to_static(flag: bool):
-    global _to_static_enabled
-    _to_static_enabled = bool(flag)
-
-
-_to_static_enabled = True
 
 
 # ---------------------------------------------------------------------------
@@ -264,3 +256,65 @@ def load(path, **configs):
     exported = jax.export.deserialize(blob)
     state = _fload(path + ".pdiparams")
     return TranslatedLayer(exported, state)
+
+
+# -- reference-parity shims -------------------------------------------------
+
+class ProgramTranslator:
+    """Reference dygraph_to_static ProgramTranslator (singleton toggling
+    to_static globally). Here to_static is trace-based; the toggle makes
+    decorated functions run eagerly when disabled."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        type(self).enable_to_static = bool(enable_to_static)
+
+
+def enable_to_static(enable: bool = True):
+    """paddle.jit.enable_to_static parity."""
+    ProgramTranslator.get_instance().enable(enable)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference dy2static debug knob: prints transformed code. The
+    trace-based to_static has no AST transforms; accepted as a no-op."""
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference dy2static logging verbosity; accepted as a no-op (use
+    standard logging on paddle_tpu.jit instead)."""
+
+
+class TracedLayer:
+    """Reference fluid dygraph TracedLayer (trace + save for inference).
+    The modern path is jit.to_static + jit.save; `trace` compiles a
+    wrapper around the layer (the layer itself is left untouched — its
+    direct calls stay eager, like the reference) and returns
+    (original_outputs, traced)."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        # compile a wrapper fn, NOT the layer: to_static(layer) would
+        # replace the layer's own call path in place
+        self._static = to_static(lambda *a, **k: layer(*a, **k))
+        self._inputs = list(inputs)
+
+    @staticmethod
+    def trace(layer, inputs):
+        outs = layer(*inputs)          # eager originals, pre-compile
+        traced = TracedLayer(layer, inputs)
+        return outs, traced
+
+    def __call__(self, *args, **kwargs):
+        return self._static(*args, **kwargs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._static, path, input_spec=self._inputs)

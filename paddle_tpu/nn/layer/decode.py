@@ -181,7 +181,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     finished_arr = np.asarray(unwrap(finished)).astype(bool)
     step_outputs = []
     time = 0
-    max_steps = max_step_num if max_step_num is not None else 256
+    # reference contract: None loops until every beam reports finished
+    max_steps = max_step_num if max_step_num is not None else float("inf")
     while time < max_steps and not finished_arr.all():
         prev_finished = finished_arr
         out, states, inputs, step_finished = decoder.step(
@@ -192,9 +193,13 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         finished_arr = sf if decoder.tracks_own_finished \
             else (prev_finished | sf)
         if impute_finished and prev_finished.any():
-            # freeze emissions of beams that were already finished
+            # zero float emissions of already-finished beams; integer
+            # fields (predicted_ids/parent_ids) are beam-search structure
+            # and must survive for the gather_tree backtrace
             def _impute(t):
                 arr = unwrap(t)
+                if not jnp.issubdtype(arr.dtype, jnp.floating):
+                    return t
                 mask = prev_finished.reshape(
                     prev_finished.shape + (1,) * (arr.ndim
                                                   - prev_finished.ndim))

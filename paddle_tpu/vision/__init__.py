@@ -4,3 +4,4 @@ from . import transforms
 from . import datasets
 from .models import *  # noqa: F401,F403
 from . import ops  # noqa: F401
+from .image import set_image_backend, get_image_backend, image_load  # noqa: F401,E402

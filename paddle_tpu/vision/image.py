@@ -1,0 +1,49 @@
+"""paddle.vision image backend (reference
+`python/paddle/vision/image.py:23,90,110`): pluggable pil/cv2 loader."""
+from __future__ import annotations
+
+_image_backend = "pil"
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but "
+            f"got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file with the selected backend; 'tensor' returns a
+    CHW uint8 paddle Tensor."""
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but "
+            f"got {backend}")
+    if backend == "cv2":
+        from ..utils import try_import
+
+        cv2 = try_import("cv2")
+        return cv2.imread(path)
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return Tensor._wrap(jnp.asarray(arr.transpose(2, 0, 1)))

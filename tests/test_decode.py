@@ -124,3 +124,69 @@ class TestReviewRegressions:
             warnings.simplefilter("always")
             nn.dynamic_decode(dec, inits=init, max_step_num=4)
         assert not [x for x in w if "int64" in str(x.message)]
+
+
+class TestParityShims:
+    def test_program_translator_toggle(self):
+        calls = {"n": 0}
+
+        @paddle.jit.to_static
+        def f(x):
+            calls["n"] += 1
+            return x * 2
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        f(x)
+        n_after_compile = calls["n"]
+        paddle.jit.ProgramTranslator().enable(False)
+        try:
+            f(x)
+            # eager path re-runs the python body every call
+            assert calls["n"] == n_after_compile + 1
+        finally:
+            paddle.jit.ProgramTranslator().enable(True)
+
+    def test_traced_layer(self, tmp_path):
+        lin = nn.Linear(3, 2)
+        x = paddle.to_tensor(np.ones((1, 3), np.float32))
+        outs, traced = paddle.jit.TracedLayer.trace(lin, [x])
+        y = traced(x)
+        np.testing.assert_allclose(np.asarray(y.numpy()),
+                                   np.asarray(outs.numpy()), rtol=1e-6)
+
+    def test_image_backend(self, tmp_path):
+        from PIL import Image
+        from paddle_tpu.vision import (get_image_backend, image_load,
+                                       set_image_backend)
+
+        p = str(tmp_path / "t.png")
+        Image.new("RGB", (4, 5), (255, 0, 0)).save(p)
+        assert get_image_backend() == "pil"
+        img = image_load(p)
+        assert img.size == (4, 5)
+        t = image_load(p, backend="tensor")
+        assert tuple(t.shape) == (3, 5, 4)
+        with pytest.raises(ValueError):
+            set_image_backend("bogus")
+
+    def test_distributed_parallel_mode_and_wait(self):
+        import paddle_tpu.distributed as dist
+
+        assert dist.ParallelMode.PIPELINE_PARALLEL == 2
+        t = paddle.to_tensor(np.ones(3, np.float32))
+        dist.wait(t)  # no-op completion barrier
+
+    def test_distributed_split_layers(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import fleet
+
+        fleet.init(is_collective=True,
+                   strategy=dist.DistributedStrategy())
+        lin = dist.split(None, (8, 4), "linear", axis=1)
+        out = lin(paddle.to_tensor(np.ones((2, 8), np.float32)))
+        assert tuple(out.shape) == (2, 4)
+        emb = dist.split(None, (16, 8), "embedding")
+        out = emb(paddle.to_tensor(np.array([1, 3], np.int64)))
+        assert tuple(out.shape) == (2, 8)
+        with pytest.raises(ValueError):
+            dist.split(None, (4, 4), "conv")
