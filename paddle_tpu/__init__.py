@@ -77,11 +77,17 @@ _static_mode = False
 def enable_static():
     global _static_mode
     _static_mode = True
+    from .static import program as _sp
+
+    _sp._install_hook()
 
 
 def disable_static():
     global _static_mode
     _static_mode = False
+    from .static import program as _sp
+
+    _sp._remove_hook()
 
 
 def in_dynamic_mode():

@@ -42,6 +42,12 @@ def _is_diff_dtype(arr) -> bool:
 # amp_auto_cast.h).  Signature: fn(op_name, tensor_args) -> tensor_args.
 _amp_cast_hook = None
 
+# Static-graph recording hook — installed by paddle_tpu.static while a
+# Program is being built (reference: LayerHelper.append_op into the
+# default ProgramDesc).  Signature:
+# fn(op_name, primal, tensor_args, kwargs, out_tensors) -> None.
+_static_record_hook = None
+
 
 def apply_op(
     name: str,
@@ -80,7 +86,12 @@ def apply_op(
 
     if not diff_idx:
         out = primal(*arrays, **kwargs)
-        return _wrap_outs(name, out, n_outs, stop_gradient=True)
+        outs_w = _wrap_outs(name, out, n_outs, stop_gradient=True)
+        if _static_record_hook is not None:
+            _static_record_hook(name, primal, tensor_args, kwargs,
+                                outs_w if isinstance(outs_w, tuple)
+                                else (outs_w,))
+        return outs_w
 
     def _primal_on_diff(*diff_arrays):
         full = list(arrays)
@@ -99,6 +110,9 @@ def apply_op(
     )
     for t in outs_list:
         t._grad_node = node
+    if _static_record_hook is not None:
+        _static_record_hook(name, primal, tensor_args, kwargs,
+                            tuple(outs_list))
     return out_tensors
 
 

@@ -8,5 +8,52 @@ control-flow ops, and no-op mode toggles.
 from ..jit import InputSpec  # noqa: F401
 from . import nn  # noqa: F401
 from .nn import cond, while_loop  # noqa: F401
+from .program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    data, Executor, Scope, global_scope, scope_guard, cpu_places,
+    cuda_places, create_global_var, gradients, append_backward,
+    name_scope, device_guard, BuildStrategy, ExecutionStrategy,
+    CompiledProgram, ParallelExecutor, Print, ExponentialMovingAverage,
+    accuracy, auc,
+)
+from ..framework.io import save, load  # noqa: F401 — state save/load
+from ..nn.layer_base import ParamAttr as _ParamAttr
 
-__all__ = ["InputSpec", "nn", "cond", "while_loop"]
+
+class WeightNormParamAttr(_ParamAttr):
+    """Reference WeightNormParamAttr (fluid/param_attr.py): ParamAttr
+    plus the weight-norm `dim`. Weight normalization itself is applied
+    by nn.utils.weight_norm-style reparameterization; the attr carries
+    the intent through layer construction."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.dim = dim
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """static.create_parameter (same factory as the top-level API;
+    imported lazily — the top-level symbol is defined after subpackage
+    imports run)."""
+    import paddle_tpu as paddle
+
+    return paddle.create_parameter(shape, dtype, name=name, attr=attr,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+__all__ = [
+    "InputSpec", "nn", "cond", "while_loop", "Program", "program_guard",
+    "default_main_program", "default_startup_program", "data", "Executor",
+    "Scope", "global_scope", "scope_guard", "cpu_places", "cuda_places",
+    "create_global_var", "gradients", "append_backward", "name_scope",
+    "device_guard", "BuildStrategy", "ExecutionStrategy",
+    "CompiledProgram", "ParallelExecutor", "Print",
+    "ExponentialMovingAverage", "accuracy", "auc", "save", "load",
+    "create_parameter", "WeightNormParamAttr",
+]

@@ -1,0 +1,504 @@
+"""paddle.static Program/Executor compatibility layer (reference:
+`python/paddle/fluid/framework.py` Program/Variable,
+`python/paddle/fluid/executor.py:625` Executor).
+
+TPU-native design: there is no ProgramDesc IR — while static mode is on,
+every dispatched op is RECORDED (name, pure-jax primal, input refs,
+attrs, outputs) into the current Program via the dispatch chokepoint
+(`core/dispatch.py _static_record_hook`).  On first replay the recorded
+op list is finalized into SSA form: intermediates become slot indices
+(their Tensor objects are released), leaves (placeholders, parameters,
+captured constants) are read LIVE at run time — so parameter updates
+between Executor.run calls take effect, exactly like the reference
+executor reading scope variables.  `Executor.run` replays the SSA DAG
+under `jax.jit` with feeds substituted: the InterpreterCore's job done
+by the compiler (SURVEY.md §7).
+
+Known limitation (documented contract): ops whose ATTRIBUTES are derived
+from input shapes at trace time (e.g. reshape/flatten computing a target
+from a `None` batch dim recorded as 1) bake those attrs; declare real
+sizes in `static.data` when such ops depend on them.
+"""
+from __future__ import annotations
+
+import contextlib
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as dispatch_mod
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+
+class _RawOp:
+    __slots__ = ("name", "primal", "inputs", "kwargs", "outputs")
+
+    def __init__(self, name, primal, inputs, kwargs, outputs):
+        self.name = name
+        self.primal = primal
+        self.inputs = inputs      # list of Tensor | const
+        self.kwargs = kwargs
+        self.outputs = outputs    # list of Tensor (strong refs until
+        #                           finalize; keeps ids stable)
+
+
+class _SSAOp:
+    __slots__ = ("name", "primal", "in_refs", "kwargs", "out_slots")
+
+    def __init__(self, name, primal, in_refs, kwargs, out_slots):
+        self.name = name
+        self.primal = primal
+        # in_refs: ('slot', i) | ('leaf', i) | ('const', value)
+        self.in_refs = in_refs
+        self.kwargs = kwargs
+        self.out_slots = out_slots
+
+
+class Program:
+    """Recorded op list + feed/fetch registry (reference
+    `framework.py Program`)."""
+
+    def __init__(self):
+        self._raw: List[_RawOp] = []
+        self._ssa: Optional[List[_SSAOp]] = None
+        self._leaves: List[Tensor] = []           # live-read at replay
+        self._feed_vars: Dict[str, Tensor] = {}
+        # fetch resolution: id -> (weakref, kind, index); validated by
+        # identity at fetch time so a reused id can never mis-resolve
+        self._locator: Dict[int, tuple] = {}
+        self._name_locator: Dict[str, tuple] = {}
+        self._cache = {}
+
+    # -- recording ------------------------------------------------------
+    def _record(self, name, primal, tensor_args, kwargs, outs):
+        if self._ssa is not None:
+            raise RuntimeError(
+                "Program was already executed; build a new Program "
+                "instead of appending ops after Executor.run")
+        self._raw.append(_RawOp(name, primal, list(tensor_args),
+                                dict(kwargs), list(outs)))
+        self._cache.clear()
+
+    def _register_data(self, name, t: Tensor):
+        self._feed_vars[name] = t
+
+    def global_block(self):
+        return self
+
+    @property
+    def ops(self):
+        return self._raw if self._ssa is None else self._ssa
+
+    def list_vars(self):
+        return list(self._feed_vars.values())
+
+    # -- finalize to SSA ------------------------------------------------
+    def _finalize(self):
+        if self._ssa is not None:
+            return
+        slot_of: Dict[int, int] = {}
+        leaf_of: Dict[int, int] = {}
+        n_slots = 0
+        ssa = []
+        for op in self._raw:
+            in_refs = []
+            for a in op.inputs:
+                if isinstance(a, Tensor):
+                    if id(a) in slot_of:
+                        in_refs.append(("slot", slot_of[id(a)]))
+                    else:
+                        li = leaf_of.get(id(a))
+                        if li is None:
+                            li = len(self._leaves)
+                            leaf_of[id(a)] = li
+                            self._leaves.append(a)   # live-read later
+                            self._locator[id(a)] = (
+                                weakref.ref(a), "leaf", li)
+                            if getattr(a, "name", None):
+                                self._name_locator[a.name] = ("leaf", li)
+                        in_refs.append(("leaf", li))
+                else:
+                    in_refs.append(("const", a))
+            out_slots = []
+            for o in op.outputs:
+                s = n_slots
+                n_slots += 1
+                slot_of[id(o)] = s
+                out_slots.append(s)
+                self._locator[id(o)] = (weakref.ref(o), "slot", s)
+                if getattr(o, "name", None):
+                    self._name_locator[o.name] = ("slot", s)
+            ssa.append(_SSAOp(op.name, op.primal, in_refs, op.kwargs,
+                              out_slots))
+        # placeholders that never feed an op still need locators
+        for fname, t in self._feed_vars.items():
+            if id(t) not in self._locator:
+                li = len(self._leaves)
+                self._leaves.append(t)
+                self._locator[id(t)] = (weakref.ref(t), "leaf", li)
+                self._name_locator[fname] = ("leaf", li)
+        self._n_slots = n_slots
+        self._ssa = ssa
+        self._raw = []            # release intermediate Tensor refs
+
+    def _locate(self, target):
+        """Resolve a fetch/feed target (Tensor or name) to
+        ('leaf'|'slot', index) with identity validation."""
+        if isinstance(target, str):
+            loc = self._name_locator.get(target)
+            if loc is None:
+                raise KeyError(f"no variable named {target!r} in this "
+                               "program")
+            return loc
+        ent = self._locator.get(id(target))
+        if ent is not None:
+            ref, kind, idx = ent
+            if ref() is target:
+                return (kind, idx)
+        raise KeyError("fetch target was not produced by this program")
+
+    # -- replay ---------------------------------------------------------
+    def _replay(self, feed_arrays: Dict[str, object], fetch_locs):
+        self._finalize()
+        ssa = self._ssa
+        n_slots = self._n_slots
+        feed_leaf_idx = {}
+        for fname in feed_arrays:
+            kind, idx = self._locate(self._feed_vars[fname])
+            if kind != "leaf":
+                raise KeyError(f"feed target {fname!r} is not a leaf")
+            feed_leaf_idx[fname] = idx
+
+        def run(feeds, leaf_arrays):
+            leaves = list(leaf_arrays)
+            for fname, arr in feeds.items():
+                leaves[feed_leaf_idx[fname]] = arr
+            env: List[object] = [None] * n_slots
+            for op in ssa:
+                args = []
+                for kind, v in op.in_refs:
+                    if kind == "slot":
+                        args.append(env[v])
+                    elif kind == "leaf":
+                        args.append(leaves[v])
+                    else:
+                        args.append(v)
+                out = op.primal(*args, **op.kwargs)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                for s, o in zip(op.out_slots, outs):
+                    env[s] = o
+            result = []
+            for kind, idx in fetch_locs:
+                result.append(env[idx] if kind == "slot" else leaves[idx])
+            return tuple(result)
+
+        key = (tuple(sorted(feed_arrays)), tuple(fetch_locs))
+        jitted = self._cache.get(key)
+        if jitted is None:
+            jitted = jax.jit(run)
+            self._cache[key] = jitted
+        # leaves read LIVE: parameter updates between runs take effect
+        leaf_arrays = [t._data for t in self._leaves]
+        return jitted(feed_arrays, leaf_arrays)
+
+    def __repr__(self):
+        n = len(self._raw) if self._ssa is None else len(self._ssa)
+        return f"Program(num_ops={n})"
+
+
+_default_main = Program()
+_default_startup = Program()
+_current_main: Program = _default_main
+_current_startup: Program = _default_startup
+
+
+def default_main_program() -> Program:
+    return _current_main
+
+
+def default_startup_program() -> Program:
+    return _current_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Scope the recording target (reference framework.py
+    program_guard)."""
+    global _current_main, _current_startup
+    old_m, old_s = _current_main, _current_startup
+    _current_main = main_program
+    if startup_program is not None:
+        _current_startup = startup_program
+    _install_hook()
+    try:
+        yield
+    finally:
+        _current_main = old_m
+        _current_startup = old_s
+        _sync_hook()
+
+
+def _record_hook(name, primal, tensor_args, kwargs, outs):
+    _current_main._record(name, primal, tensor_args, kwargs, outs)
+
+
+def _install_hook():
+    dispatch_mod._static_record_hook = _record_hook
+
+
+def _remove_hook():
+    dispatch_mod._static_record_hook = None
+
+
+def _sync_hook():
+    """Hook active only while static mode is on."""
+    import paddle_tpu as paddle
+
+    if getattr(paddle, "_static_mode", False):
+        _install_hook()
+    else:
+        _remove_hook()
+
+
+def data(name, shape, dtype=None, lod_level=0):
+    """Declare a feed placeholder (reference static.data): a zero tensor
+    registered with the current Program; Executor.run feeds override it.
+
+    `None`/-1 dims are recorded at size 1 and may be fed at any size —
+    but ops whose attributes derive from input shapes at build time
+    (reshape/flatten with computed targets) bake the build-time shape;
+    declare real sizes when using those.
+    """
+    dt = dtype_mod.convert_dtype(dtype) if dtype else \
+        dtype_mod.get_default_dtype()
+    concrete = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
+    t = Tensor._wrap(jnp.zeros(concrete, dt), stop_gradient=True)
+    t.name = name
+    _current_main._register_data(name, t)
+    return t
+
+
+class Scope:
+    """Minimal scope (reference framework Scope): name -> Tensor."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, Tensor._wrap(jnp.zeros(())))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    raise RuntimeError("cuda_places: no CUDA devices in the TPU build; "
+                       "this build executes on TPU/CPU via XLA")
+
+
+class Executor:
+    """Replay executor (reference `fluid/executor.py:625`): `run`
+    substitutes feeds into the recorded program and returns fetched
+    arrays. Fetch targets may be Tensors or variable names."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        prog = program or _current_main
+        if isinstance(prog, CompiledProgram):
+            prog = prog._program
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        feed_arrays = {}
+        for k, v in feed.items():
+            if k not in prog._feed_vars:
+                raise KeyError(f"feed target {k!r} was not declared with "
+                               "static.data in this program")
+            want = prog._feed_vars[k]._data
+            arr = jnp.asarray(np.asarray(v)).astype(want.dtype)
+            feed_arrays[k] = arr
+        prog._finalize()
+        fetch_locs = tuple(prog._locate(t) for t in fetch_list)
+        outs = prog._replay(feed_arrays, fetch_locs)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor._wrap(o) for o in outs]
+
+    def close(self):
+        pass
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor._wrap(jnp.full(tuple(int(s) for s in shape), value,
+                              dtype_mod.convert_dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference static.gradients: grads of targets w.r.t. inputs via
+    the eager tape (ops recorded under static mode also ran eagerly, so
+    the tape exists)."""
+    from ..core.autograd import grad as _grad
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(list(targets), list(inputs),
+                 grad_outputs=target_gradients, allow_unused=True,
+                 retain_graph=True)
+
+
+append_backward = gradients  # closest analog: produce grads explicitly
+
+
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+class BuildStrategy:
+    """Config stub (reference BuildStrategy): knobs are XLA's job."""
+
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """Pass-through (reference compiler.py CompiledProgram): replay is
+    already jit-compiled; with_data_parallel is a no-op wrapper."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, *a, **k):
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+ParallelExecutor = CompiledProgram
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase='both'):
+    """Debug print op (reference fluid.layers.Print)."""
+    arr = input._value() if isinstance(input, Tensor) else input
+    jax.debug.print((message or "") + " {}", arr)
+    return input
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference
+    `fluid/optimizer.py ExponentialMovingAverage`): update() after each
+    step; apply()/restore() swap shadow weights in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._tracked = []
+        self._step = 0
+
+    def update(self, parameters=None):
+        if parameters is None:
+            raise ValueError("pass parameters=model.parameters()")
+        self._step += 1
+        # bias-limited dynamic decay like the reference
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        tracked = []
+        for p in parameters:
+            key = p.name or f"param_{id(p)}"
+            prev = self._shadow.get(key)
+            arr = p._value().astype(jnp.float32)
+            self._shadow[key] = arr if prev is None else \
+                d * prev + (1 - d) * arr
+            tracked.append((p, key))
+        self._tracked = tracked
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p, key in self._tracked:
+            self._backup[key] = p._value()
+            p._set_data(self._shadow[key].astype(p._value().dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p, key in self._tracked:
+            if key in self._backup:
+                p._set_data(self._backup.pop(key))
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=min(num_thresholds, 4095))
+    preds = np.asarray(input.numpy() if isinstance(input, Tensor)
+                       else input)
+    if preds.ndim == 1 or preds.shape[-1] == 1:
+        preds = np.stack([1 - preds.reshape(-1),
+                          preds.reshape(-1)], axis=1)
+    m.update(preds, np.asarray(label.numpy()
+                               if isinstance(label, Tensor) else label))
+    val = m.accumulate()
+    return (Tensor._wrap(jnp.asarray(val, jnp.float32)),) * 3

@@ -1,0 +1,200 @@
+"""paddle.static Program/Executor compat layer (reference
+fluid/framework.py Program, fluid/executor.py:625)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+class TestProgramExecutor:
+    def test_record_replay_with_feeds(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 4], 'float32')
+            w = paddle.create_parameter([4, 2], 'float32')
+            y = paddle.nn.functional.relu(paddle.matmul(x, w)) + 1.0
+        exe = static.Executor()
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        r, = exe.run(main, feed={'x': a}, fetch_list=[y])
+        expect = np.maximum(a @ np.asarray(w.numpy()), 0) + 1.0
+        np.testing.assert_allclose(r, expect, rtol=1e-5)
+        assert len(main.ops) >= 3
+
+    def test_replay_different_batch_size(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2, 3], 'float32')
+            y = (x * 2).sum(axis=1)
+        exe = static.Executor()
+        big = np.ones((7, 3), np.float32)
+        r, = exe.run(main, feed={'x': big}, fetch_list=[y])
+        np.testing.assert_allclose(r, np.full(7, 6.0))
+
+    def test_unknown_feed_raises(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            y = x + 1
+        with pytest.raises(KeyError):
+            static.Executor().run(main, feed={'bogus': np.ones(2)},
+                                  fetch_list=[y])
+
+    def test_fetch_placeholder_and_unproduced(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            y = x * 3
+        exe = static.Executor()
+        r, = exe.run(main, feed={'x': np.array([1, 2], np.float32)},
+                     fetch_list=[x])
+        np.testing.assert_allclose(r, [1, 2])
+
+    def test_program_guard_scopes_recording(self, static_mode):
+        p1, p2 = static.Program(), static.Program()
+        with static.program_guard(p1):
+            a = static.data('a', [2], 'float32')
+            _ = a + 1
+        with static.program_guard(p2):
+            b = static.data('b', [2], 'float32')
+            _ = b * 2
+            _ = b - 1
+        assert len(p1.ops) == 1
+        assert len(p2.ops) == 2
+
+    def test_eager_mode_not_recorded(self):
+        # static mode off: dispatch hook must be uninstalled
+        main = static.Program()
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        _ = t + 1
+        assert len(main.ops) == 0
+
+    def test_gradients(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [3], 'float32')
+            w = paddle.create_parameter([3], 'float32')
+            w.stop_gradient = False
+            loss = (w * 2).sum()
+        g, = static.gradients(loss, w)
+        np.testing.assert_allclose(np.asarray(g.numpy()), [2, 2, 2])
+
+
+class TestStaticExtras:
+    def test_ema(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(2, 2, bias_attr=False)
+        ema = static.ExponentialMovingAverage(0.9)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        ema.update(parameters=lin.parameters())
+        lin.weight._set_data(lin.weight._value() * 0.0)
+        ema.update(parameters=lin.parameters())
+        with ema.apply():
+            applied = np.asarray(lin.weight.numpy())
+            # shadow is a decayed blend, nonzero (w0 contributes)
+            assert np.abs(applied).sum() > 0
+        # restored to the zeroed live weights
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), 0.0)
+
+    def test_scope_and_places(self):
+        s = static.Scope()
+        v = s.var("a")
+        assert s.find_var("a") is v
+        with static.scope_guard(s):
+            assert static.global_scope() is s
+        assert len(static.cpu_places(2)) == 2
+        with pytest.raises(RuntimeError):
+            static.cuda_places()
+
+    def test_compiled_program_passthrough(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            y = x + 5
+        cp = static.CompiledProgram(main).with_data_parallel()
+        r, = static.Executor().run(cp._program,
+                                   feed={'x': np.zeros(2, np.float32)},
+                                   fetch_list=[y])
+        np.testing.assert_allclose(r, [5, 5])
+
+    def test_accuracy(self):
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]],
+                                         np.float32))
+        label = paddle.to_tensor(np.array([[1], [0]], np.int64))
+        acc = static.accuracy(pred, label)
+        assert float(acc) == 1.0
+
+
+class TestReviewRegressions:
+    def test_param_updates_visible_across_runs(self, static_mode):
+        """Replay must read LIVE parameter values (review: frozen
+        snapshots meant the model never learned)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            w = paddle.create_parameter([2], 'float32')
+            y = (x * w).sum()
+        exe = static.Executor()
+        feed = np.ones(2, np.float32)
+        r1, = exe.run(main, feed={'x': feed}, fetch_list=[y])
+        w._set_data(w._value() + 1.0)
+        r2, = exe.run(main, feed={'x': feed}, fetch_list=[y])
+        np.testing.assert_allclose(r2 - r1, 2.0, rtol=1e-6)
+
+    def test_fetch_by_name(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            y = x + 7
+            y.name = "out_y"
+        # re-finalize happens inside run; fetch by string name
+        r, = static.Executor().run(
+            main, feed={'x': np.zeros(2, np.float32)},
+            fetch_list=["out_y"])
+        np.testing.assert_allclose(r, [7, 7])
+        with pytest.raises(KeyError):
+            static.Executor().run(main,
+                                  feed={'x': np.zeros(2, np.float32)},
+                                  fetch_list=["nope"])
+
+    def test_append_after_run_rejected(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            y = x * 2
+        static.Executor().run(main, feed={'x': np.ones(2, np.float32)},
+                              fetch_list=[y])
+        with pytest.raises(RuntimeError):
+            with static.program_guard(main):
+                _ = x + 1
+
+    def test_intermediates_released_after_finalize(self, static_mode):
+        import gc
+        import weakref as wr
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            mid = x * 2           # intermediate
+            y = mid + 1
+        ref = wr.ref(mid)
+        static.Executor().run(main, feed={'x': np.ones(2, np.float32)},
+                              fetch_list=[y])
+        del mid
+        gc.collect()
+        assert ref() is None  # program does not pin intermediates
+
+    def test_weight_norm_param_attr(self):
+        attr = static.WeightNormParamAttr(dim=0, name="w")
+        assert attr.dim == 0
