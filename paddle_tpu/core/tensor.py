@@ -281,6 +281,13 @@ class Tensor:
             node.outputs = [self if o is out else o for o in node.outputs]
         if not out.stop_gradient:
             self.stop_gradient = False
+        # static-graph recording: later consumers of `self` must resolve
+        # to `out`'s SSA slot, not self's pre-in-place producer
+        from . import dispatch as _dispatch_mod
+
+        if _dispatch_mod._static_record_hook is not None:
+            _dispatch_mod._static_record_hook(
+                "__alias__", None, [out], {}, [self])
         return self
 
     # -- in-place / value ops ---------------------------------------------

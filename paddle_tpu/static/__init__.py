@@ -14,7 +14,8 @@ from .program import (  # noqa: F401
     cuda_places, create_global_var, gradients, append_backward,
     name_scope, device_guard, BuildStrategy, ExecutionStrategy,
     CompiledProgram, ParallelExecutor, Print, ExponentialMovingAverage,
-    accuracy, auc,
+    accuracy, auc, save_inference_model, load_inference_model,
+    serialize_program, deserialize_program,
 )
 from ..framework.io import save, load  # noqa: F401 — state save/load
 from ..nn.layer_base import ParamAttr as _ParamAttr
@@ -55,5 +56,7 @@ __all__ = [
     "device_guard", "BuildStrategy", "ExecutionStrategy",
     "CompiledProgram", "ParallelExecutor", "Print",
     "ExponentialMovingAverage", "accuracy", "auc", "save", "load",
+    "save_inference_model", "load_inference_model", "serialize_program",
+    "deserialize_program",
     "create_parameter", "WeightNormParamAttr",
 ]

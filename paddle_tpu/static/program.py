@@ -71,6 +71,7 @@ class Program:
         # identity at fetch time so a reused id can never mis-resolve
         self._locator: Dict[int, tuple] = {}
         self._name_locator: Dict[str, tuple] = {}
+        self._declared_shapes: Dict[str, list] = {}
         self._cache = {}
 
     # -- recording ------------------------------------------------------
@@ -83,8 +84,10 @@ class Program:
                                 dict(kwargs), list(outs)))
         self._cache.clear()
 
-    def _register_data(self, name, t: Tensor):
+    def _register_data(self, name, t: Tensor, declared_shape=None):
         self._feed_vars[name] = t
+        if declared_shape is not None:
+            self._declared_shapes[name] = list(declared_shape)
 
     def global_block(self):
         return self
@@ -105,6 +108,16 @@ class Program:
         n_slots = 0
         ssa = []
         for op in self._raw:
+            if op.name == "__alias__":
+                # in-place rebind: target (outputs[0]) now denotes the
+                # source's (inputs[0]) value for all LATER consumers
+                src_t = op.inputs[0]
+                dst_t = op.outputs[0]
+                if id(src_t) in slot_of:
+                    slot_of[id(dst_t)] = slot_of[id(src_t)]
+                    self._locator[id(dst_t)] = (
+                        weakref.ref(dst_t), "slot", slot_of[id(src_t)])
+                continue
             in_refs = []
             for a in op.inputs:
                 if isinstance(a, Tensor):
@@ -233,7 +246,7 @@ def program_guard(main_program, startup_program=None):
     _current_main = main_program
     if startup_program is not None:
         _current_startup = startup_program
-    _install_hook()
+    _sync_hook()   # records only while static mode is enabled
     try:
         yield
     finally:
@@ -278,7 +291,8 @@ def data(name, shape, dtype=None, lod_level=0):
     concrete = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
     t = Tensor._wrap(jnp.zeros(concrete, dt), stop_gradient=True)
     t.name = name
-    _current_main._register_data(name, t)
+    # declared shape kept on the Program (None dims export symbolically)
+    _current_main._register_data(name, t, declared_shape=shape)
     return t
 
 
@@ -338,6 +352,15 @@ class Executor:
         prog = program or _current_main
         if isinstance(prog, CompiledProgram):
             prog = prog._program
+        if isinstance(prog, _LoadedProgram):
+            feed_arrays = {k: jnp.asarray(np.asarray(v))
+                           for k, v in (feed or {}).items()}
+            outs = prog.run(feed_arrays)
+            picked = [outs[i] for i in (fetch_list
+                                        or range(len(outs)))]
+            if return_numpy:
+                return [np.asarray(o) for o in picked]
+            return [Tensor._wrap(o) for o in picked]
         feed = feed or {}
         fetch_list = fetch_list or []
         feed_arrays = {}
@@ -502,3 +525,128 @@ def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
                                if isinstance(label, Tensor) else label))
     val = m.accumulate()
     return (Tensor._wrap(jnp.asarray(val, jnp.float32)),) * 3
+
+
+# -- inference model serialization (reference fluid/io.py
+# save_inference_model/load_inference_model; format here: serialized
+# StableHLO via jax.export + a pickle sidecar with feed/fetch meta) -----
+
+class _LoadedProgram:
+    """Deserialized inference program: runnable by Executor.run with
+    feed={name: array}, fetch_list=the returned fetch handles."""
+
+    def __init__(self, exported, feed_names, n_fetch):
+        self._exported = exported
+        self._feed_names = list(feed_names)
+        self._n_fetch = n_fetch
+
+    def run(self, feed):
+        args = [feed[n] for n in self._feed_names]
+        return self._exported.call(*args)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Freeze the program for deployment: parameters are baked into the
+    exported StableHLO; only `feed_vars` stay as runtime inputs."""
+    import pickle
+
+    prog = program or default_main_program()
+    prog._finalize()
+    feed_names = [getattr(t, "name", None) or str(i)
+                  for i, t in enumerate(feed_vars)]
+    for t, n in zip(feed_vars, feed_names):
+        if n not in prog._feed_vars:
+            raise KeyError(f"feed var {n!r} was not declared with "
+                           "static.data")
+    fetch_locs = tuple(prog._locate(t) for t in fetch_vars)
+    feed_locs = [prog._locate(prog._feed_vars[n]) for n in feed_names]
+    leaf_arrays = [t._data for t in prog._leaves]
+    ssa = prog._ssa
+    n_slots = prog._n_slots
+
+    def infer(*feed_arrays):
+        leaves = list(leaf_arrays)
+        for (kind, idx), arr in zip(feed_locs, feed_arrays):
+            leaves[idx] = arr
+        env = [None] * n_slots
+        for op in ssa:
+            args = [env[v] if kind == "slot"
+                    else (leaves[v] if kind == "leaf" else v)
+                    for kind, v in op.in_refs]
+            out = op.primal(*args, **op.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for s, o in zip(op.out_slots, outs):
+                env[s] = o
+        return tuple(env[idx] if kind == "slot" else leaves[idx]
+                     for kind, idx in fetch_locs)
+
+    # None/-1 declared dims export as SYMBOLIC dims so the frozen model
+    # accepts any size there (jax shape polymorphism)
+    shapes = []
+    n_sym = 0
+    for n in feed_names:
+        t = prog._feed_vars[n]
+        declared = prog._declared_shapes.get(n, list(t._data.shape))
+        parts = []
+        symbolic = False
+        for s in declared:
+            if s is None or int(s) < 0:
+                parts.append(f"_sdim{n_sym}")
+                n_sym += 1
+                symbolic = True
+            else:
+                parts.append(str(int(s)))
+        if symbolic:
+            dims = jax.export.symbolic_shape(", ".join(parts))
+            shapes.append(jax.ShapeDtypeStruct(tuple(dims),
+                                               t._data.dtype))
+        else:
+            shapes.append(jax.ShapeDtypeStruct(t._data.shape,
+                                               t._data.dtype))
+    exported = jax.export.export(jax.jit(infer))(*shapes)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"feed_names": feed_names,
+                     "n_fetch": len(fetch_vars)}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_target_names, fetch_targets) — run with
+    `Executor.run(program, feed={...}, fetch_list=fetch_targets)`."""
+    import pickle
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    prog = _LoadedProgram(exported, meta["feed_names"], meta["n_fetch"])
+    fetch_targets = list(range(meta["n_fetch"]))
+    return prog, meta["feed_names"], fetch_targets
+
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    """Bytes = pickled {hlo, feed_names, n_fetch}; deserialize_program
+    rebuilds a runnable _LoadedProgram."""
+    import os
+    import pickle
+    import tempfile
+
+    prog = program or default_main_program()
+    with tempfile.TemporaryDirectory() as d:
+        save_inference_model(os.path.join(d, "m"), feed_vars, fetch_vars,
+                             program=prog)
+        with open(os.path.join(d, "m.pdmodel"), "rb") as f:
+            hlo = f.read()
+        with open(os.path.join(d, "m.pdiparams"), "rb") as f:
+            meta = pickle.load(f)
+    return pickle.dumps({"hlo": hlo, **meta})
+
+
+def deserialize_program(data):
+    import pickle
+
+    blob = pickle.loads(data)
+    exported = jax.export.deserialize(blob["hlo"])
+    return _LoadedProgram(exported, blob["feed_names"], blob["n_fetch"])

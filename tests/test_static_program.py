@@ -73,10 +73,20 @@ class TestProgramExecutor:
 
     def test_eager_mode_not_recorded(self):
         # static mode off: dispatch hook must be uninstalled
-        main = static.Program()
+        from paddle_tpu.core import dispatch as dispatch_mod
+
+        before = len(static.default_main_program().ops)
         t = paddle.to_tensor(np.ones(2, np.float32))
         _ = t + 1
-        assert len(main.ops) == 0
+        assert dispatch_mod._static_record_hook is None
+        assert len(static.default_main_program().ops) == before
+
+    def test_program_guard_without_static_mode_records_nothing(self):
+        p = static.Program()
+        with static.program_guard(p):
+            t = paddle.to_tensor(np.ones(2, np.float32))
+            _ = t + 1
+        assert len(p.ops) == 0
 
     def test_gradients(self, static_mode):
         main = static.Program()
@@ -198,3 +208,65 @@ class TestReviewRegressions:
     def test_weight_norm_param_attr(self):
         attr = static.WeightNormParamAttr(dim=0, name="w")
         assert attr.dim == 0
+
+    def test_save_load_inference_model(self, static_mode, tmp_path):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2, 3], 'float32')
+            w = paddle.create_parameter([3, 2], 'float32')
+            y = paddle.matmul(x, w)
+        exe = static.Executor()
+        a = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+        want, = exe.run(main, feed={'x': a}, fetch_list=[y])
+        prefix = str(tmp_path / "infer")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        # params baked: mutating w must NOT affect the loaded model
+        w._set_data(w._value() * 0.0)
+        prog2, feed_names, fetch_targets = static.load_inference_model(
+            prefix, exe)
+        assert feed_names == ['x']
+        got, = exe.run(prog2, feed={'x': a}, fetch_list=fetch_targets)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_inplace_op_recorded_correctly(self, static_mode):
+        """In-place ops must alias correctly in the replay (review:
+        consumers resolved to the pre-in-place slot)."""
+        import paddle_tpu.nn.functional as F
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [3], 'float32')
+            h = x * 2
+            F.relu_(h)
+            y = h + 1
+        r, = static.Executor().run(
+            main, feed={'x': np.array([-1, 0, 2], np.float32)},
+            fetch_list=[y])
+        np.testing.assert_allclose(r, [1, 1, 5])
+
+    def test_serialize_deserialize_roundtrip(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2], 'float32')
+            y = x * 4
+        blob = static.serialize_program([x], [y], program=main)
+        prog2 = static.deserialize_program(blob)
+        r, = static.Executor().run(prog2,
+                                   feed={'x': np.ones(2, np.float32)},
+                                   fetch_list=[0])
+        np.testing.assert_allclose(r, [4, 4])
+
+    def test_saved_model_dynamic_batch(self, static_mode, tmp_path):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 3], 'float32')
+            w = paddle.create_parameter([3, 2], 'float32')
+            y = paddle.matmul(x, w)
+        exe = static.Executor()
+        prefix = str(tmp_path / "dyn")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        prog2, names, fetches = static.load_inference_model(prefix, exe)
+        big = np.random.RandomState(2).randn(5, 3).astype(np.float32)
+        got, = exe.run(prog2, feed={'x': big}, fetch_list=fetches)
+        np.testing.assert_allclose(got, big @ np.asarray(w.numpy()),
+                                   rtol=1e-4)
