@@ -85,3 +85,40 @@ def gloo_barrier():
 
 def gloo_release():
     """No-op: no gloo resources to release."""
+
+
+# Parameter-server stack surface (reference fleet dataset/entry types):
+# DELIBERATELY DESCOPED on TPU (see README "Descoped") — these names
+# exist so ported code fails loudly with the reason, not an
+# AttributeError.
+def _ps_descoped(name):
+    raise NotImplementedError(
+        f"paddle.distributed.{name} belongs to the parameter-server "
+        "training stack, which this TPU build deliberately descopes: "
+        "giant embeddings are served by mesh-sharded dense embeddings "
+        "(VocabParallelEmbedding + ZeRO) instead. See README.md.")
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        _ps_descoped("InMemoryDataset")
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        _ps_descoped("QueueDataset")
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        _ps_descoped("CountFilterEntry")
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        _ps_descoped("ProbabilityEntry")
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        _ps_descoped("ShowClickEntry")
