@@ -8,5 +8,7 @@ from ..ops.fused import fused_linear_cross_entropy  # noqa: F401
 from . import distributed  # noqa: F401
 from .. import sparse  # noqa: F401 — 2.3-era import path paddle.incubate.sparse
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 
-__all__ = ["fused_linear_cross_entropy", "distributed", "sparse", "asp"]
+__all__ = ["fused_linear_cross_entropy", "distributed", "sparse", "asp",
+           "autograd"]

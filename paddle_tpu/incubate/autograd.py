@@ -1,0 +1,212 @@
+"""paddle.incubate.autograd — functional differentiation API (reference
+`python/paddle/autograd/functional.py:22,79,165,255` jvp/vjp/Jacobian/
+Hessian, re-exported under incubate.autograd).
+
+TPU-native: direct jax transform wrappers over the Tensor facade —
+forward-mode via jax.jvp (the reference builds double-backward graphs to
+emulate it), reverse via jax.vjp, Jacobian via jax.jacfwd (vmapped for
+the batched contract), Hessian via jax.hessian."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import unwrap, wrap
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _pack(arrays):
+    out = [wrap(a) for a in arrays]
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def _pure(func):
+    """Wrap a Tensor->Tensor(s) function as arrays->arrays (structure
+    preserved)."""
+
+    def f(*arrays):
+        outs = func(*[wrap(a) for a in arrays])
+        if isinstance(outs, (list, tuple)):
+            return tuple(unwrap(o) for o in outs)
+        return unwrap(outs)
+
+    return f
+
+
+def _pure_flat(func):
+    """arrays -> one flat vector (multi-output funcs concatenate)."""
+    f = _pure(func)
+
+    def flat(*arrays):
+        out = f(*arrays)
+        outs = out if isinstance(out, tuple) else (out,)
+        return jnp.concatenate([jnp.ravel(o) for o in outs])
+
+    return flat
+
+
+def vjp(func, xs, v=None):
+    """Returns (outputs, input-gradients) for cotangent v (defaults to
+    ones like the reference)."""
+    xs_l = _as_list(xs)
+    arrays = [unwrap(x) for x in xs_l]
+    f = _pure(func)
+    outs, pullback = jax.vjp(f, *arrays)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, outs)
+    else:
+        cot = tuple(unwrap(c) for c in _as_list(v))
+        if not isinstance(outs, tuple):
+            cot = cot[0]
+    grads = pullback(cot)
+    outs_t = outs if isinstance(outs, tuple) else (outs,)
+    return _pack(list(outs_t)), _pack(list(grads))
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (outputs, jvp) for tangent v (defaults to
+    ones)."""
+    xs_l = _as_list(xs)
+    arrays = [unwrap(x) for x in xs_l]
+    f = _pure(func)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        tangents = tuple(unwrap(t) for t in _as_list(v))
+    outs, tangents_out = jax.jvp(f, tuple(arrays), tangents)
+    outs_t = outs if isinstance(outs, tuple) else (outs,)
+    tan_t = tangents_out if isinstance(tangents_out, tuple) \
+        else (tangents_out,)
+    return _pack(list(outs_t)), _pack(list(tan_t))
+
+
+class Jacobian:
+    """Lazy Jacobian (reference functional.py:165).
+
+    Unbatched: flattened [out_size, total_in_size] (multi-output funcs
+    concatenate their flattened outputs; multi-input columns concatenate
+    in input order).  Batched (`is_batched=True`, single input
+    [B, ...]): per-sample [B, out_size, in_size] via vmap(jacfwd) — O(B)
+    work, no cross-batch blocks."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = _as_list(xs)
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        arrays = [unwrap(x) for x in self._xs]
+        flat = _pure_flat(self._func)
+
+        if self._is_batched:
+            if len(arrays) > 1:
+                raise NotImplementedError(
+                    "batched Jacobian supports a single input tensor "
+                    "[B, ...]; pass inputs concatenated")
+            x = arrays[0]
+
+            def per_sample(xb):
+                return flat(xb[None])
+
+            jac = jax.vmap(jax.jacfwd(per_sample))(x)   # [B, out, *in]
+            self._mat = jac.reshape(x.shape[0], jac.shape[1], -1)
+            return self._mat
+
+        jacs = jax.jacfwd(flat, argnums=tuple(range(len(arrays))))(
+            *arrays)
+        jacs = jacs if isinstance(jacs, tuple) else (jacs,)
+        rows = jacs[0].shape[0]
+        self._mat = jnp.concatenate(
+            [j.reshape(rows, -1) for j in jacs], axis=1)
+        return self._mat
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)
+
+    def __getitem__(self, idx):
+        return wrap(self._compute()[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._compute())
+
+
+class Hessian:
+    """Lazy Hessian of a scalar function (reference functional.py:255):
+    [in_size, in_size] (symmetric); batched (`is_batched=True`, single
+    input [B, n], per-sample scalar outputs): [B, n, n]."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = _as_list(xs)
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        arrays = [unwrap(x) for x in self._xs]
+        flat = _pure_flat(self._func)
+
+        if self._is_batched:
+            if len(arrays) > 1:
+                raise NotImplementedError(
+                    "batched Hessian supports a single input tensor "
+                    "[B, n]")
+            x = arrays[0]
+
+            def per_sample(xb):
+                out = flat(xb[None])
+                if out.size != 1:
+                    raise ValueError(
+                        "batched Hessian requires one scalar per sample")
+                return out.reshape(())
+
+            h = jax.vmap(jax.hessian(per_sample))(x)    # [B, *in, *in]
+            n = int(x[0].size)
+            self._mat = h.reshape(x.shape[0], n, n)
+            return self._mat
+
+        def scalar_f(*a):
+            out = flat(*a)
+            if out.size != 1:
+                raise ValueError("Hessian requires a scalar function")
+            return out.reshape(())
+
+        if len(arrays) == 1:
+            h = jax.hessian(scalar_f)(arrays[0])
+            n = arrays[0].size
+            self._mat = h.reshape(n, n)
+        else:
+            h = jax.hessian(scalar_f,
+                            argnums=tuple(range(len(arrays))))(*arrays)
+            sizes = [a.size for a in arrays]
+            blocks = []
+            for i in range(len(arrays)):
+                row = [jnp.reshape(h[i][j], (sizes[i], sizes[j]))
+                       for j in range(len(arrays))]
+                blocks.append(jnp.concatenate(row, axis=1))
+            self._mat = jnp.concatenate(blocks, axis=0)
+        return self._mat
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)
+
+    def __getitem__(self, idx):
+        return wrap(self._compute()[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._compute())

@@ -1,0 +1,99 @@
+"""incubate.autograd functional API: jvp/vjp/Jacobian/Hessian vs
+analytic oracles (reference python/paddle/autograd/functional.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as F
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestFunctional:
+    def test_vjp_default_cotangent(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out, grad = F.vjp(lambda a: a * a, x)
+        np.testing.assert_allclose(_np(out), [1, 4, 9])
+        np.testing.assert_allclose(_np(grad), [2, 4, 6])
+
+    def test_vjp_custom_cotangent(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        v = paddle.to_tensor(np.array([10.0, 100.0], np.float32))
+        _, grad = F.vjp(lambda a: a * 3, x, v)
+        np.testing.assert_allclose(_np(grad), [30, 300])
+
+    def test_jvp(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        out, tangent = F.jvp(lambda a: a * a, x, v)
+        np.testing.assert_allclose(_np(out), [4, 9])
+        np.testing.assert_allclose(_np(tangent), [4, 0])  # 2*x*v
+
+    def test_jacobian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = F.Jacobian(lambda a: paddle.concat([a * a, a.sum()
+                                                .reshape([1])]), x)
+        assert J.shape == [3, 2]
+        np.testing.assert_allclose(J.numpy(),
+                                   [[2, 0], [0, 4], [1, 1]], rtol=1e-5)
+        np.testing.assert_allclose(_np(J[1]), [0, 4], rtol=1e-5)
+
+    def test_jacobian_multi_input(self):
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.array([3.0], np.float32))
+        J = F.Jacobian(lambda x, y: x * y, [a, b])
+        # d(x*y)/dx = diag(y), d/dy = x  -> [2, 3]
+        assert J.shape == [2, 3]
+        np.testing.assert_allclose(J.numpy(),
+                                   [[3, 0, 1], [0, 3, 2]], rtol=1e-5)
+
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = F.Hessian(lambda a: (a * a * a).sum(), x)
+        assert H.shape == [2, 2]
+        np.testing.assert_allclose(H.numpy(), [[6, 0], [0, 12]],
+                                   rtol=1e-5)
+
+    def test_hessian_scalar_check(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = F.Hessian(lambda a: a * 2, x)  # vector output
+        with pytest.raises(ValueError):
+            H.numpy()
+
+
+class TestReviewRegressions:
+    def test_multi_output_jacobian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = F.Jacobian(lambda a: (a * a, a.sum().reshape([1])), x)
+        assert J.shape == [3, 2]
+        np.testing.assert_allclose(J.numpy(),
+                                   [[2, 0], [0, 4], [1, 1]], rtol=1e-5)
+
+    def test_batched_jacobian(self):
+        B = 3
+        x = paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(B, 2))
+        J = F.Jacobian(lambda a: a * a, x, is_batched=True)
+        assert J.shape == [B, 2, 2]
+        got = J.numpy()
+        for b in range(B):
+            np.testing.assert_allclose(
+                got[b], np.diag(2 * np.arange(2 * b, 2 * b + 2)),
+                rtol=1e-5)
+
+    def test_batched_hessian(self):
+        B = 2
+        x = paddle.to_tensor(np.ones((B, 3), np.float32))
+        H = F.Hessian(lambda a: (a ** 3).sum(axis=1), x,
+                      is_batched=True)
+        assert H.shape == [B, 3, 3]
+        np.testing.assert_allclose(H.numpy()[0], np.eye(3) * 6,
+                                   rtol=1e-5)
+
+    def test_batched_multi_input_raises(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.raises(NotImplementedError):
+            F.Jacobian(lambda a, b: a + b, [x, x],
+                       is_batched=True).numpy()
