@@ -1,1 +1,2 @@
 from .recompute import recompute
+from .fs import LocalFS, HDFSClient  # noqa: F401

@@ -130,7 +130,20 @@ class Jacobian:
 
     @property
     def shape(self):
-        return list(self._compute().shape)
+        if self._mat is not None:
+            return list(self._mat.shape)
+        # sizes via eval_shape: zero FLOPs (lazy contract of the
+        # reference API)
+        import jax as _jax
+
+        arrays = [unwrap(x) for x in self._xs]
+        flat = _pure_flat(self._func)
+        if self._is_batched:
+            B = arrays[0].shape[0]
+            out = _jax.eval_shape(flat, arrays[0][:1])
+            return [B, int(out.shape[0]), int(arrays[0][0].size)]
+        out = _jax.eval_shape(flat, *arrays)
+        return [int(out.shape[0]), int(sum(a.size for a in arrays))]
 
     def __getitem__(self, idx):
         return wrap(self._compute()[idx])
@@ -201,7 +214,15 @@ class Hessian:
 
     @property
     def shape(self):
-        return list(self._compute().shape)
+        if self._mat is not None:
+            return list(self._mat.shape)
+        arrays = [unwrap(x) for x in self._xs]
+        if self._is_batched:
+            B = arrays[0].shape[0]
+            n = int(arrays[0][0].size)
+            return [B, n, n]
+        n = int(sum(a.size for a in arrays))
+        return [n, n]
 
     def __getitem__(self, idx):
         return wrap(self._compute()[idx])

@@ -249,3 +249,34 @@ class TestReviewRegressions:
             strategy=s)
         assert isinstance(opt, LocalSGDOptimizer)
         assert opt._cur_k() == 4
+
+
+class TestFleetFS:
+    def test_local_fs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        from paddle_tpu.distributed.fleet.utils.fs import (
+            FSFileExistsError, FSFileNotExistsError)
+
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / "a" / "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with pytest.raises(FSFileExistsError):
+            fs.touch(f, exist_ok=False)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert files == ["x.txt"]
+        fs.mv(f, str(tmp_path / "a" / "y.txt"))
+        assert not fs.is_exist(f)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(f, str(tmp_path / "z"))
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_raises(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+
+        with pytest.raises(NotImplementedError):
+            HDFSClient("/opt/hadoop")

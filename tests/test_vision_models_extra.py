@@ -8,17 +8,22 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 
 
-def _x(n=1, s=64):
+def _x(n=1, s=48):
     rs = np.random.RandomState(0)
     return paddle.to_tensor(rs.randn(n, 3, s, s).astype(np.float32))
 
 
 @pytest.mark.parametrize("name,make,kw", [
-    ("squeezenet1_0", M.squeezenet1_0, {}),
     ("squeezenet1_1", M.squeezenet1_1, {}),
-    ("densenet121", M.densenet121, {}),
     ("shufflenet_v2_x0_25", M.shufflenet_v2_x0_25, {}),
-    ("mobilenet_v3_small", M.mobilenet_v3_small, {}),
+    # compile-heavy families under --runslow; the fast pair keeps the
+    # construction/forward path covered on every run
+    pytest.param("squeezenet1_0", M.squeezenet1_0, {},
+                 marks=pytest.mark.slow),
+    pytest.param("densenet121", M.densenet121, {},
+                 marks=pytest.mark.slow),
+    pytest.param("mobilenet_v3_small", M.mobilenet_v3_small, {},
+                 marks=pytest.mark.slow),
 ])
 def test_forward_shapes(name, make, kw):
     paddle.seed(0)
