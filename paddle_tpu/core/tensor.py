@@ -274,8 +274,44 @@ class Tensor:
         """Adopt ``out``'s payload and autograd position (in-place op result).
         The producing TapeNode's output entry is retargeted to ``self`` so the
         backward sweep finds cotangents under this tensor's identity."""
-        self._set_data(out._value())
+        old_node = self._grad_node
+        old_stop = self.stop_gradient
         node = out._grad_node
+        if node is not None and any(t is self for t in node.inputs):
+            # the producing op consumed `self` PRE-in-place: its input
+            # entry must keep the old autograd position, or the node
+            # becomes self-referential and upstream grads are dropped
+            shadow = Tensor.__new__(Tensor)
+            shadow._data = self._data
+            shadow._grad = None
+            shadow._grad_node = old_node
+            shadow.stop_gradient = old_stop
+            shadow.name = ""
+            shadow.persistable = False
+            shadow.trainable = False
+            shadow._version = 0
+            shadow._backward_hooks = None
+            shadow._trace_born = None
+            shadow._trace_grad = None
+            if old_node is None and not old_stop:
+                # leaf requiring grad: cotangents for the pre-in-place
+                # value must land on THIS tensor's .grad (reference
+                # in-place-on-leaf semantics)
+                target = self
+
+                def _route(g, _t=target):
+                    _t._accumulate_grad(g._value())
+                    return g
+
+                shadow._backward_hooks = {0: _route}
+            if old_node is not None:
+                # the old producer now emits the PRE-in-place identity
+                old_node.outputs = [shadow if o is self else o
+                                    for o in old_node.outputs]
+            node.inputs = [shadow if t is self else t
+                           for t in node.inputs]
+        self._set_data(out._value())
+        self._version += 1     # stale backward reads now raise
         self._grad_node = node
         if node is not None:
             node.outputs = [self if o is out else o for o in node.outputs]

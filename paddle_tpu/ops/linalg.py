@@ -231,3 +231,9 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
         lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
         [x],
     )
+
+
+def cond(x, p=None, name=None):
+    """Matrix condition number (reference tensor/linalg.py:656);
+    p=None means the 2-norm, matching jnp.linalg.cond's default."""
+    return op("cond", lambda a: jnp.linalg.cond(a, p=p), [x])

@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from ._helpers import as_int_list, nondiff, op, unwrap
+from ._helpers import as_int_list, nondiff, op, unwrap, wrap
 
 __all__ = [
     "add_n", "broadcast_shape", "check_shape", "diagonal", "is_complex",
@@ -209,3 +209,87 @@ def angle(x, name=None):
 
 
 __all__ += ["real", "imag", "conj", "angle"]
+
+
+# -- remaining in-place variants (reference tensor_method_func list):
+# rebind through the taped op so autograd and static recording see them
+def _inplace(base_name):
+    def fn(x, *args, **kwargs):
+        from . import math as math_ops
+        from . import manipulation as manip_ops
+
+        base = getattr(math_ops, base_name, None) or \
+            getattr(manip_ops, base_name)
+        return x._rebind_from(base(x, *args, **kwargs))
+
+    fn.__name__ = base_name + "_"
+    return fn
+
+
+ceil_ = _inplace("ceil")
+exp_ = _inplace("exp")
+floor_ = _inplace("floor")
+reciprocal_ = _inplace("reciprocal")
+round_ = _inplace("round")
+sqrt_ = _inplace("sqrt")
+erfinv_ = _inplace("erfinv")
+flatten_ = _inplace("flatten")
+
+
+def lerp_(x, y, weight, name=None):
+    from .math import lerp
+
+    return x._rebind_from(lerp(x, y, weight))
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign", name=None):
+    from .manipulation import put_along_axis
+
+    return arr._rebind_from(put_along_axis(arr, indices, values, axis,
+                                           reduce=reduce))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu results into (P, L, U) (reference:
+    tensor/linalg.py lu_unpack)."""
+    lu_data = unwrap(x)
+    pivots = unwrap(y)
+
+    def _primal(lu_arr):
+        m, n = lu_arr.shape[-2], lu_arr.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_arr[..., :, :k], -1) + jnp.eye(m, k,
+                                                       dtype=lu_arr.dtype)
+        U = jnp.triu(lu_arr[..., :k, :])
+        return L, U
+
+    L, U = op("lu_unpack", _primal, [x], n_outs=2)
+    # permutation matrices from pivots (host math on int data; batched)
+    lu_np = np.asarray(lu_data)
+    piv = np.asarray(pivots)
+    m = lu_np.shape[-2]
+    batch_shape = lu_np.shape[:-2]
+    piv2 = piv.reshape((-1, piv.shape[-1]))
+    Ps = []
+    for row in piv2:
+        perm = np.arange(m)
+        # paddle.linalg.lu pivots are 1-based (LAPACK convention)
+        for i, p in enumerate(row[: m]):
+            j = int(p) - 1
+            perm[[i, j]] = perm[[j, i]]
+        P = np.zeros((m, m), lu_np.dtype)
+        P[perm, np.arange(m)] = 1.0
+        Ps.append(P)
+    P_all = np.stack(Ps).reshape(batch_shape + (m, m)) if batch_shape \
+        else Ps[0]
+    outs = []
+    if unpack_pivots:
+        outs.append(wrap(jnp.asarray(P_all)))
+    if unpack_ludata:
+        outs += [L, U]
+    return tuple(outs)
+
+
+__all__ += ["ceil_", "exp_", "floor_", "reciprocal_", "round_", "sqrt_",
+            "erfinv_", "flatten_", "lerp_", "put_along_axis_",
+            "lu_unpack"]

@@ -271,3 +271,85 @@ class TestGradNumeric:
             fm = op(paddle.to_tensor(am.astype(np.float32))).sum().item()
             num.append((fp - fm) / (2 * eps))
         np.testing.assert_allclose(analytic, num, rtol=1e-2, atol=1e-3)
+
+
+class TestInplaceVariantsAndLinalgTail:
+    """The last tensor_method_func stragglers (in-place unary variants,
+    lu_unpack, cond) — full 222/222 reference method coverage."""
+
+    def test_inplace_unaries(self):
+        import numpy as np
+
+        t = paddle.to_tensor(np.array([1.44, 2.25], np.float32))
+        assert t.sqrt_() is t
+        np.testing.assert_allclose(t.numpy(), [1.2, 1.5], rtol=1e-5)
+        t2 = paddle.to_tensor(np.array([1.2, -1.7], np.float32))
+        t2.floor_()
+        np.testing.assert_allclose(t2.numpy(), [1.0, -2.0])
+        t3 = paddle.to_tensor(np.array([0.5], np.float32))
+        t3.exp_()
+        np.testing.assert_allclose(t3.numpy(), [np.exp(0.5)], rtol=1e-5)
+        t4 = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]],
+                                       np.float32))
+        t4.flatten_()
+        assert tuple(t4.shape) == (4,)
+
+    def test_lerp_inplace_grad(self):
+        import numpy as np
+
+        x = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.ones(3, np.float32))
+        w = paddle.to_tensor(np.float32(0.25))
+        out = x * 1  # keep graph before in-place
+        out.lerp_(y, w)
+        out.sum().backward()
+        np.testing.assert_allclose(out.numpy(), [0.25] * 3)
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   [0.75] * 3, rtol=1e-5)
+
+    def test_lu_unpack_roundtrip(self):
+        import numpy as np
+
+        rs = np.random.RandomState(0)
+        a = rs.randn(4, 4).astype(np.float32)
+        lu_d, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.lu_unpack(lu_d, piv)
+        rec = (np.asarray(P.numpy()) @ np.asarray(L.numpy())
+               @ np.asarray(U.numpy()))
+        np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
+
+    def test_cond(self):
+        import numpy as np
+
+        d = paddle.to_tensor(np.diag([4.0, 2.0]).astype(np.float32))
+        np.testing.assert_allclose(float(paddle.cond(d)), 2.0, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.cond(d, p='fro')),
+            np.linalg.cond(np.diag([4.0, 2.0]), 'fro'), rtol=1e-5)
+
+    def test_stale_inplace_read_raises(self):
+        """An op recorded BEFORE an in-place mutation of its input must
+        refuse to backprop (reference inplace version counter,
+        dense_tensor.h:177)."""
+        import numpy as np
+        import pytest as _pt
+
+        x = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        a = x * 1
+        b = a * 2          # consumes pre-in-place `a`
+        a.sqrt_()
+        with _pt.raises(RuntimeError, match="in-place"):
+            (b + a).sum().backward()
+
+    def test_lu_unpack_batched(self):
+        import numpy as np
+
+        rs = np.random.RandomState(3)
+        a = rs.randn(2, 3, 3).astype(np.float32)
+        lu_d, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.lu_unpack(lu_d, piv)
+        assert tuple(P.shape) == (2, 3, 3)
+        rec = np.einsum("bij,bjk,bkl->bil", np.asarray(P.numpy()),
+                        np.asarray(L.numpy()), np.asarray(U.numpy()))
+        np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
