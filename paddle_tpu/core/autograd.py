@@ -65,7 +65,7 @@ class TapeNode:
     collected by the python GC once user refs drop)."""
 
     __slots__ = ("vjp_fn", "inputs", "outputs", "name", "released",
-                 "materialize", "input_versions")
+                 "materialize", "input_edges")
 
     def __init__(self, vjp_fn, inputs, outputs, name="", materialize=True):
         self.vjp_fn = vjp_fn
@@ -77,9 +77,12 @@ class TapeNode:
         # cotangent pass None to the vjp instead of materialized zeros
         self.materialize = materialize
         # in-place safety (reference: DenseTensor inplace_version,
-        # dense_tensor.h:177): snapshot each input's version; backward
-        # raises if an input was modified in place after this op recorded
-        self.input_versions = [getattr(t, "_version", 0) for t in inputs]
+        # dense_tensor.h:177, and torch-style recorded edges): snapshot
+        # each input's producing node; backward raises if the tensor's
+        # grad routing changed (an in-place op consumed it afterwards),
+        # which would silently send cotangents through the wrong vjp
+        self.input_edges = [getattr(t, "_grad_node", None)
+                            for t in inputs]
 
     def release(self):
         self.vjp_fn = None
@@ -177,13 +180,13 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
             cts.append(c)
         if not any_ct:
             continue
-        for t, v0 in zip(node.inputs, node.input_versions):
-            if getattr(t, "_version", 0) != v0:
+        for t, edge in zip(node.inputs, node.input_edges):
+            if getattr(t, "_grad_node", None) is not edge:
                 raise RuntimeError(
-                    f"a tensor needed for the backward of op "
-                    f"'{node.name}' was modified by an in-place "
-                    f"operation (version {getattr(t, '_version', 0)} != "
-                    f"recorded {v0}); clone() it before the in-place op")
+                    f"a tensor consumed by op '{node.name}' was later "
+                    "modified by an in-place operation, so its backward "
+                    "routing is no longer valid; clone() it before the "
+                    "in-place op")
         in_cts = node.vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
         for t, g in zip(node.inputs, in_cts):
             if g is None:

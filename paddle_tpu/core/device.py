@@ -228,3 +228,76 @@ class CUDAPinnedPlace(Place):
 class NPUPlace(Place):
     def __init__(self, device_id=0):
         super().__init__("npu", device_id)
+
+
+class XPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("xpu", device_id)
+
+
+class NPUPlaceAlias(Place):
+    pass
+
+
+class MLUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("mlu", device_id)
+
+
+class IPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("ipu", device_id)
+
+
+# -- capability predicates (reference device/__init__.py): this build
+# targets TPU via XLA, so every vendor-specific predicate is False and
+# vendor device enumeration returns the XLA device list ---------------
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def get_cudnn_version():
+    return None
+
+
+def get_all_device_type():
+    """Device types visible to XLA (reference returns Place types)."""
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu",)]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [s for s in get_available_device()
+            if not s.startswith("cpu")]

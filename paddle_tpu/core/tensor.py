@@ -289,7 +289,7 @@ class Tensor:
             shadow.name = ""
             shadow.persistable = False
             shadow.trainable = False
-            shadow._version = 0
+            shadow._version = self._version   # pre-in-place version
             shadow._backward_hooks = None
             shadow._trace_born = None
             shadow._trace_grad = None

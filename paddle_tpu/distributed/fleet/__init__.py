@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from ..strategy import DistributedStrategy
 from .topology import (
@@ -133,3 +134,106 @@ def worker_index() -> int:
 def barrier_worker():
     from ..collective import barrier
     barrier()
+
+
+# -- reference fleet namespace classes ---------------------------------
+
+class Role:
+    """Reference fleet.base.role_maker Role enum."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Collective role maker (reference role_maker.py): rank/size from
+    the jax multi-controller runtime; the PS server role is descoped."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        if not is_collective:
+            raise NotImplementedError(
+                "parameter-server roles are descoped in the TPU build "
+                "(see README); use is_collective=True")
+        self._is_collective = True
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+
+
+class UtilBase:
+    """Reference fleet UtilBase: cross-worker helpers."""
+
+    def all_reduce(self, input, mode="sum"):
+        import numpy as _np
+
+        if jax.process_count() <= 1:
+            return input
+        from jax.experimental import multihost_utils
+
+        arr = multihost_utils.process_allgather(jnp.asarray(input))
+        if mode == "sum":
+            return _np.asarray(arr.sum(axis=0))
+        if mode == "max":
+            return _np.asarray(arr.max(axis=0))
+        if mode == "min":
+            return _np.asarray(arr.min(axis=0))
+        raise ValueError(f"unknown mode {mode}")
+
+    def barrier(self, comm_world="worker"):
+        barrier_worker()
+
+    def get_file_shard(self, files):
+        n = jax.process_count()
+        i = jax.process_index()
+        return list(files)[i::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        if jax.process_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+
+class Fleet:
+    """Reference fleet.Fleet class; this module IS the default instance
+    (fleet.init etc. are module functions), and `Fleet()` returns a
+    handle exposing the same surface for code that instantiates it."""
+
+    def __getattr__(self, name):
+        import paddle_tpu.distributed.fleet as _mod
+
+        return getattr(_mod, name)
+
+
+def _ps_descoped_gen(name):
+    def ctor(*a, **k):
+        raise NotImplementedError(
+            f"fleet.{name} is part of the parameter-server data pipeline "
+            "— descoped in the TPU build (see README)")
+
+    return ctor
+
+
+MultiSlotDataGenerator = _ps_descoped_gen("MultiSlotDataGenerator")
+MultiSlotStringDataGenerator = _ps_descoped_gen(
+    "MultiSlotStringDataGenerator")

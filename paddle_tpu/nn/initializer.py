@@ -189,3 +189,44 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4
     return 1.0
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed conv upsampling
+    (reference nn/initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D shape")
+        c_out, c_in, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        ctr_h = f_h - 1 if kh % 2 == 1 else f_h - 0.5
+        ctr_w = f_w - 1 if kw % 2 == 1 else f_w - 0.5
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - np.abs(og[0] - ctr_h) / f_h)
+                * (1 - np.abs(og[1] - ctr_w) / f_w))
+        w = np.zeros(shape, np.float32)
+        for i in range(min(c_out, c_in)):
+            w[i, i] = filt
+        import jax.numpy as jnp
+
+        return jnp.asarray(w.astype(dtype))
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference nn/initializer set_global_initializer: default init for
+    subsequently created parameters (None resets)."""
+    global _global_initializer
+    if weight_init is None and bias_init is None:
+        _global_initializer = None
+    else:
+        _global_initializer = (weight_init, bias_init)
+
+
+def _get_global_initializer():
+    return _global_initializer

@@ -137,12 +137,22 @@ class Layer:
         name = None
         if attr is False:
             return None
+        attr_init = None
         if attr is not None:
-            init = getattr(attr, "initializer", None) or init
+            attr_init = getattr(attr, "initializer", None)
             trainable = getattr(attr, "trainable", True)
             name = getattr(attr, "name", None)
             if isinstance(attr, I.Initializer):
-                init = attr
+                attr_init = attr
+        # precedence (reference set_global_initializer contract): explicit
+        # ParamAttr initializer > global initializer > layer default
+        if attr_init is not None:
+            init = attr_init
+        else:
+            g = I._get_global_initializer() if hasattr(
+                I, "_get_global_initializer") else None
+            if g is not None and (g[1] if is_bias else g[0]) is not None:
+                init = g[1] if is_bias else g[0]
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         arr = init(shape, dtype)

@@ -237,3 +237,12 @@ def cond(x, p=None, name=None):
     """Matrix condition number (reference tensor/linalg.py:656);
     p=None means the 2-norm, matching jnp.linalg.cond's default."""
     return op("cond", lambda a: jnp.linalg.cond(a, p=p), [x])
+
+
+def _lu_unpack_alias(*args, **kwargs):
+    from .misc import lu_unpack as _f
+
+    return _f(*args, **kwargs)
+
+
+lu_unpack = _lu_unpack_alias

@@ -263,29 +263,30 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
         U = jnp.triu(lu_arr[..., :k, :])
         return L, U
 
-    L, U = op("lu_unpack", _primal, [x], n_outs=2)
-    # permutation matrices from pivots (host math on int data; batched)
-    lu_np = np.asarray(lu_data)
-    piv = np.asarray(pivots)
-    m = lu_np.shape[-2]
-    batch_shape = lu_np.shape[:-2]
-    piv2 = piv.reshape((-1, piv.shape[-1]))
-    Ps = []
-    for row in piv2:
-        perm = np.arange(m)
-        # paddle.linalg.lu pivots are 1-based (LAPACK convention)
-        for i, p in enumerate(row[: m]):
-            j = int(p) - 1
-            perm[[i, j]] = perm[[j, i]]
-        P = np.zeros((m, m), lu_np.dtype)
-        P[perm, np.arange(m)] = 1.0
-        Ps.append(P)
-    P_all = np.stack(Ps).reshape(batch_shape + (m, m)) if batch_shape \
-        else Ps[0]
     outs = []
     if unpack_pivots:
+        # permutation matrices from pivots (host math; batched). Only
+        # pay the device->host sync when P is actually requested.
+        lu_np = np.asarray(lu_data)
+        piv = np.asarray(pivots)
+        m = lu_np.shape[-2]
+        batch_shape = lu_np.shape[:-2]
+        piv2 = piv.reshape((-1, piv.shape[-1]))
+        Ps = []
+        for row in piv2:
+            perm = np.arange(m)
+            # paddle.linalg.lu pivots are 1-based (LAPACK convention)
+            for i, p in enumerate(row[: m]):
+                j = int(p) - 1
+                perm[[i, j]] = perm[[j, i]]
+            P = np.zeros((m, m), lu_np.dtype)
+            P[perm, np.arange(m)] = 1.0
+            Ps.append(P)
+        P_all = np.stack(Ps).reshape(batch_shape + (m, m)) \
+            if batch_shape else Ps[0]
         outs.append(wrap(jnp.asarray(P_all)))
     if unpack_ludata:
+        L, U = op("lu_unpack", _primal, [x], n_outs=2)
         outs += [L, U]
     return tuple(outs)
 

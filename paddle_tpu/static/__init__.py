@@ -15,7 +15,11 @@ from .program import (  # noqa: F401
     name_scope, device_guard, BuildStrategy, ExecutionStrategy,
     CompiledProgram, ParallelExecutor, Print, ExponentialMovingAverage,
     accuracy, auc, save_inference_model, load_inference_model,
-    serialize_program, deserialize_program,
+    serialize_program, deserialize_program, save_to_file, load_from_file,
+    serialize_persistables, deserialize_persistables, save_program_state,
+    load_program_state, set_program_state, normalize_program, py_func,
+    Variable, xpu_places, npu_places, mlu_places, IpuStrategy,
+    IpuCompiledProgram, ipu_shard_guard, set_ipu_shard,
 )
 from ..framework.io import save, load  # noqa: F401 — state save/load
 from ..nn.layer_base import ParamAttr as _ParamAttr
@@ -57,6 +61,11 @@ __all__ = [
     "CompiledProgram", "ParallelExecutor", "Print",
     "ExponentialMovingAverage", "accuracy", "auc", "save", "load",
     "save_inference_model", "load_inference_model", "serialize_program",
-    "deserialize_program",
+    "deserialize_program", "save_to_file", "load_from_file",
+    "serialize_persistables", "deserialize_persistables",
+    "save_program_state", "load_program_state", "set_program_state",
+    "normalize_program", "py_func", "Variable", "xpu_places",
+    "npu_places", "mlu_places", "IpuStrategy", "IpuCompiledProgram",
+    "ipu_shard_guard", "set_ipu_shard",
     "create_parameter", "WeightNormParamAttr",
 ]

@@ -650,3 +650,133 @@ def deserialize_program(data):
     blob = pickle.loads(data)
     exported = jax.export.deserialize(blob["hlo"])
     return _LoadedProgram(exported, blob["feed_names"], blob["n_fetch"])
+
+
+# -- program state save/load (reference static/io.py
+# save/load_program_state, serialize/deserialize_persistables) ---------
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None):
+    """Pickle the live leaf (parameter) arrays of the program."""
+    import pickle
+
+    prog = program or default_main_program()
+    prog._finalize()
+    state = {i: np.asarray(t._data) for i, t in enumerate(prog._leaves)}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    state = pickle.loads(data)
+    program._finalize()
+    for i, arr in state.items():
+        if i < len(program._leaves):
+            t = program._leaves[i]
+            t._set_data(jnp.asarray(arr).astype(t._data.dtype))
+
+
+def save_program_state(dirname=None, program=None):
+    prog = program or default_main_program()
+    prog._finalize()
+    return {i: np.asarray(t._data) for i, t in enumerate(prog._leaves)}
+
+
+def load_program_state(state_or_dirname=None, var_list=None):
+    """Reference loads a params dir; here program state round-trips as
+    in-memory dicts (save_program_state -> set_program_state) or through
+    serialize/deserialize_persistables for on-disk bytes. A directory
+    path raises instead of silently returning the live state."""
+    if isinstance(state_or_dirname, dict) or state_or_dirname is None:
+        return state_or_dirname if state_or_dirname is not None \
+            else save_program_state()
+    raise NotImplementedError(
+        "load_program_state from a directory is not supported: persist "
+        "state with serialize_persistables/save_to_file and restore via "
+        "deserialize_persistables, or pass the dict from "
+        "save_program_state")
+
+
+def set_program_state(program, state):
+    program._finalize()
+    for i, arr in state.items():
+        if isinstance(i, int) and i < len(program._leaves):
+            t = program._leaves[i]
+            t._set_data(jnp.asarray(arr).astype(t._data.dtype))
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Reference: prune to the feed->fetch subgraph. The SSA replay
+    already executes only recorded ops; returned unchanged."""
+    return program
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static py_func: host-python op inside a program. Eager
+    recording runs the function directly; a custom backward wraps it as
+    a PyLayer."""
+    from ..autograd import PyLayer
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if backward_func is None:
+        return func(*xs)
+
+    class _PyFunc(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            return func(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_func(*ctx.saved_tensor(), *grads)
+
+    return _PyFunc.apply(*xs)
+
+
+# reference static Variable is the graph-mode tensor handle; here the
+# Tensor facade plays both roles, so isinstance checks against
+# static.Variable hold for everything static.data / ops return
+Variable = Tensor
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("xpu_places: no XPU devices in the TPU build")
+
+
+def npu_places(device_ids=None):
+    raise RuntimeError("npu_places: no NPU devices in the TPU build")
+
+
+def mlu_places(device_ids=None):
+    raise RuntimeError("mlu_places: no MLU devices in the TPU build")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU support is not part of the TPU "
+                                  "build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU support is not part of the TPU "
+                                  "build")
+
+
+def ipu_shard_guard(*a, **k):
+    raise NotImplementedError("IPU support is not part of the TPU build")
+
+
+def set_ipu_shard(*a, **k):
+    raise NotImplementedError("IPU support is not part of the TPU build")

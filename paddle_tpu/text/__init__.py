@@ -1,6 +1,9 @@
 """paddle.text parity (reference: python/paddle/text)."""
-from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, UCIHousing, Conll05st, Movielens, WMT14, WMT16,
+)
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 
 __all__ = ["UCIHousing", "Imdb", "Imikolov", "viterbi_decode",
+           "Conll05st", "Movielens", "WMT14", "WMT16",
            "ViterbiDecoder"]

@@ -185,3 +185,44 @@ class Imikolov(Dataset):
 
     def __getitem__(self, i):
         return self.data[i]
+
+
+class _DownloadDataset(Dataset):
+    """Base for corpora the reference fetches from its dataset server —
+    this environment has no egress and the archive parsers are not
+    implemented, so construction always raises with that reason (the
+    honest alternative to returning an object whose __getitem__ would
+    fail later)."""
+
+    _NAME = "dataset"
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"paddle.text.{self._NAME} downloads and parses its corpus "
+            "from the dataset server, which needs network access this "
+            "build does not have; load the data with paddle.io.Dataset "
+            "over local files instead")
+
+
+class Conll05st(_DownloadDataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py)."""
+
+    _NAME = "Conll05st"
+
+
+class Movielens(_DownloadDataset):
+    """MovieLens-1M ratings (reference text/datasets/movielens.py)."""
+
+    _NAME = "Movielens"
+
+
+class WMT14(_DownloadDataset):
+    """WMT14 en-fr (reference text/datasets/wmt14.py)."""
+
+    _NAME = "WMT14"
+
+
+class WMT16(_DownloadDataset):
+    """WMT16 en-de (reference text/datasets/wmt16.py)."""
+
+    _NAME = "WMT16"

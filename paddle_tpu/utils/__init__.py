@@ -124,3 +124,25 @@ class unique_name:
 
 
 __all__ += ["try_import", "deprecated", "run_check", "unique_name"]
+
+
+def require_version(min_version, max_version=None):
+    """Reference paddle.utils.require_version: assert the installed
+    framework version is within [min_version, max_version]."""
+    from .. import version as _v
+
+    def key(s):
+        return tuple(int(p) for p in str(s).split(".")[:3] if p.isdigit())
+
+    cur = key(_v.full_version)
+    if key(min_version) > cur:
+        raise Exception(
+            f"installed version {_v.full_version} < required "
+            f"{min_version}")
+    if max_version is not None and key(max_version) < cur:
+        raise Exception(
+            f"installed version {_v.full_version} > allowed "
+            f"{max_version}")
+
+
+__all__ += ["require_version"]

@@ -2,7 +2,8 @@
 from .resnet import (
     ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
     resnet101, resnet152, resnext50_32x4d, resnext101_32x4d, wide_resnet50_2,
-    wide_resnet101_2,
+    wide_resnet101_2, resnext50_64x4d, resnext101_64x4d, resnext152_32x4d,
+    resnext152_64x4d,
 )
 from .lenet import LeNet
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
@@ -16,7 +17,7 @@ from .densenet import (
 from .shufflenetv2 import (
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
     shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
-    shufflenet_v2_x2_0,
+    shufflenet_v2_x2_0, shufflenet_v2_swish,
 )
 from .googlenet import GoogLeNet, googlenet
 from .inceptionv3 import InceptionV3, inception_v3

@@ -141,3 +141,21 @@ def shufflenet_v2_x1_5(pretrained=False, **kw):
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
     return _make(2.0, pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    """x1.0 backbone with swish activations (reference
+    shufflenet_v2_swish). The act swap happens post-construction so the
+    block topology stays shared."""
+    net = _make(1.0, pretrained, **kw)
+    from ... import nn
+
+    def _swap(layer):
+        for name, child in list(layer._sub_layers.items()):
+            if isinstance(child, nn.ReLU):
+                layer._sub_layers[name] = nn.Swish()
+            else:
+                _swap(child)
+
+    _swap(net)
+    return net

@@ -22,7 +22,8 @@ from ..ops._helpers import op, unwrap, wrap
 __all__ = [
     'yolo_loss', 'yolo_box', 'deform_conv2d', 'DeformConv2D',
     'roi_align', 'RoIAlign', 'roi_pool', 'RoIPool', 'psroi_pool',
-    'PSRoIPool', 'nms', 'ConvNormActivation',
+    'PSRoIPool', 'nms', 'ConvNormActivation', 'read_file',
+    'decode_jpeg',
 ]
 
 
@@ -605,3 +606,29 @@ class ConvNormActivation(Sequential):
         if activation_layer is not None:
             layers.append(activation_layer())
         super().__init__(*layers)
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (reference vision/ops.py:838)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return wrap(jnp.frombuffer(data, dtype=jnp.uint8))
+
+
+def decode_jpeg(x, mode='unchanged', name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference :885 uses
+    nvjpeg; here PIL does the host-side decode)."""
+    import io
+
+    from PIL import Image
+
+    data = bytes(np.asarray(unwrap(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == 'gray':
+        img = img.convert('L')
+    elif mode == 'rgb':
+        img = img.convert('RGB')
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return wrap(jnp.asarray(arr.transpose(2, 0, 1)))

@@ -1,0 +1,252 @@
+"""New vision transforms + misc namespace additions (reference:
+vision/transforms functional + transform classes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+
+def _img(h=8, w=8, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 255, (h, w, c)).astype(np.uint8)
+
+
+class TestFunctionalColor:
+    def test_brightness(self):
+        img = _img()
+        out = T.adjust_brightness(img, 2.0)
+        np.testing.assert_array_equal(
+            out, (img.astype(np.float32) * 2).clip(0, 255).astype(np.uint8))
+
+    def test_contrast_identity(self):
+        img = _img()
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                                   atol=1)
+
+    def test_saturation_zero_is_gray(self):
+        img = _img()
+        out = T.adjust_saturation(img, 0.0)
+        # all channels equal when fully desaturated
+        assert np.abs(out[..., 0].astype(int)
+                      - out[..., 1].astype(int)).max() <= 1
+
+    def test_hue_roundtrip(self):
+        img = _img()
+        out = T.adjust_hue(T.adjust_hue(img, 0.25), -0.25)
+        assert np.abs(out.astype(int) - img.astype(int)).mean() < 12
+
+    def test_hue_range_check(self):
+        with pytest.raises(ValueError):
+            T.adjust_hue(_img(), 0.7)
+
+    def test_grayscale(self):
+        out = T.to_grayscale(_img(), 3)
+        assert out.shape == (8, 8, 3)
+        assert (out[..., 0] == out[..., 1]).all()
+
+
+class TestGeometric:
+    def test_pad_crop(self):
+        img = _img()
+        p = T.pad(img, 2, fill=7)
+        assert p.shape == (12, 12, 3)
+        assert (p[:2] == 7).all()
+        c = T.crop(p, 2, 2, 8, 8)
+        np.testing.assert_array_equal(c, img)
+
+    def test_rotate_360_identity(self):
+        img = _img(16, 16)
+        out = T.rotate(img, 360.0)
+        # interior pixels survive a full rotation
+        np.testing.assert_allclose(out[4:12, 4:12].astype(int),
+                                   img[4:12, 4:12].astype(int), atol=2)
+
+    def test_rotate_90(self):
+        img = np.zeros((9, 9, 1), np.uint8)
+        img[0, :, 0] = 255  # top row
+        out = T.rotate(img, 90.0)
+        # 90-degree rotation moves the bright line; content survives
+        assert out.sum() > 0
+
+    def test_affine_identity(self):
+        img = _img(10, 10)
+        out = T.affine(img, 0.0, (0, 0), 1.0, (0.0, 0.0))
+        np.testing.assert_allclose(out.astype(int), img.astype(int),
+                                   atol=1)
+
+    def test_affine_translate(self):
+        img = np.zeros((8, 8), np.float32)
+        img[3, 3] = 1.0
+        out = T.affine(img, 0.0, (2, 1), 1.0, (0.0, 0.0))
+        assert out[4, 5] > 0.9   # moved by (+2 x, +1 y)
+
+    def test_perspective_identity(self):
+        img = _img(8, 8)
+        pts = [(0, 0), (7, 0), (7, 7), (0, 7)]
+        out = T.perspective(img, pts, pts)
+        np.testing.assert_allclose(out.astype(int), img.astype(int),
+                                   atol=1)
+
+    def test_erase(self):
+        img = np.ones((6, 6, 3), np.uint8) * 9
+        out = T.erase(img, 1, 2, 2, 3, 0)
+        assert (out[1:3, 2:5] == 0).all()
+        assert out[0, 0, 0] == 9
+
+
+class TestTransformClasses:
+    def test_color_jitter_runs(self):
+        import random
+
+        random.seed(0)
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(_img())
+        assert out.shape == (8, 8, 3)
+
+    def test_random_resized_crop(self):
+        import random
+
+        random.seed(1)
+        out = T.RandomResizedCrop(4)(_img(16, 16))
+        assert out.shape[:2] == (4, 4)
+
+    def test_random_rotation_erasing_affine_perspective(self):
+        import random
+
+        random.seed(2)
+        img = _img(12, 12)
+        assert T.RandomRotation(30)(img).shape == img.shape
+        assert T.RandomAffine(10, translate=(0.1, 0.1),
+                              scale=(0.9, 1.1), shear=5)(img).shape \
+            == img.shape
+        assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+        assert T.RandomErasing(prob=1.0)(img).shape == img.shape
+        assert T.Grayscale(3)(img).shape == img.shape
+        assert T.Pad(1)(img).shape == (14, 14, 3)
+
+
+class TestNamespaceAdditions:
+    def test_device_surface(self):
+        d = paddle.device
+        assert d.is_compiled_with_cuda() is False
+        assert "cpu" in d.get_all_device_type()
+        assert d.get_available_device()
+
+    def test_bilinear_initializer(self):
+        init = paddle.nn.initializer.Bilinear()
+        w = np.asarray(init((2, 2, 4, 4), np.float32))
+        assert w.shape == (2, 2, 4, 4)
+        assert w[0, 0].sum() > 0 and w[0, 1].sum() == 0
+
+    def test_set_global_initializer(self):
+        import paddle_tpu.nn as nn
+
+        paddle.nn.initializer.set_global_initializer(
+            paddle.nn.initializer.Constant(3.0),
+            paddle.nn.initializer.Constant(1.0))
+        try:
+            lin = nn.Linear(2, 2)
+            np.testing.assert_allclose(np.asarray(lin.weight.numpy()),
+                                       3.0)
+            np.testing.assert_allclose(np.asarray(lin.bias.numpy()), 1.0)
+        finally:
+            paddle.nn.initializer.set_global_initializer(None)
+            assert paddle.nn.initializer._get_global_initializer() is None
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        from paddle_tpu.vision import ops as V
+
+        p = str(tmp_path / "t.jpg")
+        Image.new("RGB", (6, 5), (200, 10, 30)).save(p)
+        raw = V.read_file(p)
+        assert str(raw.dtype) == "uint8"
+        img = V.decode_jpeg(raw)
+        assert tuple(img.shape) == (3, 5, 6)
+
+    def test_linalg_lu_unpack_alias(self):
+        a = np.array([[4.0, 3.0], [6.0, 3.0]], np.float32)
+        lu_d, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_d, piv)
+        rec = (np.asarray(P.numpy()) @ np.asarray(L.numpy())
+               @ np.asarray(U.numpy()))
+        np.testing.assert_allclose(rec, a, rtol=1e-4)
+
+    def test_require_version(self):
+        paddle.utils.require_version("0.1.0")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("99.0.0")
+
+    def test_text_dataset_stubs(self):
+        for cls in (paddle.text.Conll05st, paddle.text.Movielens,
+                    paddle.text.WMT14, paddle.text.WMT16):
+            with pytest.raises(NotImplementedError):
+                cls()
+
+    def test_resnext_and_swish_variants(self):
+        from paddle_tpu.vision import models as M
+
+        paddle.seed(0)
+        net = M.shufflenet_v2_swish(num_classes=5)
+        acts = [type(l).__name__ for l in net.sublayers()]
+        assert "Swish" in acts and "ReLU" not in acts
+        assert callable(M.resnext50_64x4d)
+        assert callable(M.resnext152_32x4d)
+
+    def test_static_state_roundtrip(self):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [2], 'float32')
+                w = paddle.create_parameter([2], 'float32')
+                y = (x * w).sum()
+            exe = static.Executor()
+            feed = np.ones(2, np.float32)
+            r1, = exe.run(main, feed={'x': feed}, fetch_list=[y])
+            state = static.save_program_state(program=main)
+            w._set_data(w._value() * 0.0)
+            static.set_program_state(main, state)
+            r2, = exe.run(main, feed={'x': feed}, fetch_list=[y])
+            np.testing.assert_allclose(r2, r1, rtol=1e-6)
+        finally:
+            paddle.disable_static()
+
+
+class TestInplaceEdgeRegressions:
+    def test_chained_inplace_grads(self):
+        """Two chained in-place ops on the same tensor must backprop
+        (review: the shadow carried version 0 and spuriously raised)."""
+        x = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        a = x * 1
+        a.sqrt_()
+        a.exp_()
+        a.sum().backward()
+        # d/dx exp(sqrt(x)) = exp(sqrt(x)) / (2 sqrt(x))
+        np.testing.assert_allclose(
+            np.asarray(x.grad.numpy()), [np.exp(2.0) / 4.0], rtol=1e-4)
+
+    def test_consumed_then_mutated_still_raises(self):
+        x = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        a = x * 1
+        b = a + 1.0
+        a.exp_()
+        with pytest.raises(RuntimeError, match="in-place"):
+            b.sum().backward()
+
+    def test_variable_isinstance(self):
+        from paddle_tpu import static
+
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        assert isinstance(t, static.Variable)
+
+    def test_load_program_state_dir_raises(self):
+        from paddle_tpu import static
+
+        with pytest.raises(NotImplementedError):
+            static.load_program_state("/tmp/some_dir")
