@@ -9,6 +9,21 @@ from . import distributed  # noqa: F401
 from .. import sparse  # noqa: F401 — 2.3-era import path paddle.incubate.sparse
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import operators  # noqa: F401
+from . import tensor  # noqa: F401
+from . import optimizer  # noqa: F401
+from .operators import (  # noqa: F401
+    graph_send_recv, graph_khop_sampler, graph_sample_neighbors,
+    graph_reindex, softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
+from .tensor import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["fused_linear_cross_entropy", "distributed", "sparse", "asp",
-           "autograd"]
+__all__ = [
+    "fused_linear_cross_entropy", "distributed", "sparse", "asp",
+    "autograd",
+    "LookAhead", "ModelAverage",
+    "softmax_mask_fuse_upper_triangle", "softmax_mask_fuse",
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+]

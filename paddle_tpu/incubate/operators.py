@@ -1,0 +1,239 @@
+"""Incubate operators: graph learning + fused transformer softmax.
+
+Reference: python/paddle/incubate/operators/{graph_send_recv.py,
+graph_khop_sampler.py, graph_sample_neighbors.py, graph_reindex.py,
+softmax_mask_fuse.py, softmax_mask_fuse_upper_triangle.py}.
+
+TPU-native split:
+- ``graph_send_recv`` and the fused softmaxes are device ops — scatter
+  segments and masked softmax both lower to single XLA fusions (the
+  reference needs hand-written CUDA for each).
+- The samplers (`graph_khop_sampler`, `graph_sample_neighbors`,
+  `graph_reindex`) are *host-side*: their output shapes are data-dependent
+  (number of sampled edges), which XLA cannot compile.  In a TPU pipeline
+  they belong on the host next to the DataLoader — sample/reindex on CPU,
+  feed the static-shape subgraph to the device (same place the reference
+  runs them when no GPU is present, graph_khop_sampler_op.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import op, unwrap, wrap
+
+__all__ = [
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+]
+
+
+# ---------------------------------------------------------------------------
+# device ops
+# ---------------------------------------------------------------------------
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather ``x[src_index]`` then scatter-reduce into rows ``dst_index``.
+
+    Rows receiving no message are 0 (all pool types), matching the
+    reference kernel's zero-initialised output
+    (paddle/phi/kernels/cpu/graph_send_recv_kernel.cc).
+    """
+    if pool_type not in ("sum", "mean", "max", "min"):
+        raise ValueError(
+            "pool_type should be `sum`, `mean`, `max` or `min`, "
+            "but received %s" % pool_type)
+    if out_size is None:
+        n = int(unwrap(x).shape[0])
+    else:
+        n = int(out_size) if not isinstance(out_size, Tensor) \
+            else int(out_size.item())
+
+    def primal(xa, src, dst):
+        src = src.astype(jnp.int32).reshape(-1)
+        dst = dst.astype(jnp.int32).reshape(-1)
+        msgs = xa[src]
+        out_shape = (n,) + xa.shape[1:]
+        if pool_type == "sum":
+            return jnp.zeros(out_shape, xa.dtype).at[dst].add(msgs)
+        cnt = jnp.zeros((n,), jnp.float32).at[dst].add(1.0)
+        cnt = cnt.reshape((n,) + (1,) * (xa.ndim - 1))
+        if pool_type == "mean":
+            s = jnp.zeros(out_shape, xa.dtype).at[dst].add(msgs)
+            return s / jnp.maximum(cnt, 1.0).astype(xa.dtype)
+        if pool_type == "max":
+            m = jnp.full(out_shape, -jnp.inf, xa.dtype).at[dst].max(msgs)
+        else:
+            m = jnp.full(out_shape, jnp.inf, xa.dtype).at[dst].min(msgs)
+        return jnp.where(cnt > 0, m, jnp.zeros_like(m))
+
+    return op(f"graph_send_recv_{pool_type}", primal, [x, src_index, dst_index])
+
+
+def _softmax_f32(y, dtype):
+    y = y - y.max(axis=-1, keepdims=True)
+    e = jnp.exp(y)
+    return (e / e.sum(axis=-1, keepdims=True)).astype(dtype)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) computed in f32, returned in x's dtype.
+
+    Reference: fused_softmax_mask op
+    (paddle/fluid/operators/fused_softmax_mask_op.cu); on TPU the
+    add+softmax pair is one XLA fusion, so the composition IS the kernel.
+    """
+    def primal(xa, ma):
+        return _softmax_f32(
+            xa.astype(jnp.float32) + ma.astype(jnp.float32), xa.dtype)
+
+    return op("softmax_mask_fuse", primal, [x, mask])
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangle-masked) softmax over the last two dims.
+
+    Positions col > row get -10000 before the softmax, matching the
+    reference kernel
+    (paddle/fluid/operators/fused_softmax_mask_upper_triangle_op.cu).
+    """
+    def primal(xa):
+        s_q, s_k = xa.shape[-2], xa.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool))
+        y = jnp.where(causal, xa.astype(jnp.float32), -10000.0)
+        return _softmax_f32(y, xa.dtype)
+
+    return op("softmax_mask_fuse_upper_triangle", primal, [x])
+
+
+# ---------------------------------------------------------------------------
+# host-side samplers
+# ---------------------------------------------------------------------------
+
+def _np1d(t, dtype=np.int64):
+    return np.asarray(unwrap(t)).reshape(-1).astype(dtype)
+
+
+def _reindex_np(x, neighbors):
+    """Order-preserving relabel: x first, then new neighbor ids by first
+    appearance.  Returns (mapped_neighbors, out_nodes)."""
+    out_nodes = list(x)
+    table = {int(v): i for i, v in enumerate(x)}
+    mapped = np.empty(len(neighbors), np.int64)
+    for i, v in enumerate(neighbors):
+        v = int(v)
+        j = table.get(v)
+        if j is None:
+            j = len(out_nodes)
+            table[v] = j
+            out_nodes.append(v)
+        mapped[i] = j
+    return mapped, np.asarray(out_nodes, np.int64)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Relabel sampled subgraph node ids from 0 (host-side).
+
+    Returns (reindex_src, reindex_dst, out_nodes): edges dst[i]->src over
+    the new ids, input nodes occupying ids [0, len(x)).
+    """
+    xs = _np1d(x)
+    nb = _np1d(neighbors)
+    ct = _np1d(count)
+    mapped, out_nodes = _reindex_np(xs, nb)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), ct)
+    return wrap(jnp.asarray(mapped)), wrap(jnp.asarray(dst)), \
+        wrap(jnp.asarray(out_nodes))
+
+
+def _sample_one_hop(row, colptr, nodes, sample_size, eids, rng):
+    """CSC one-hop: neighbors of n are row[colptr[n]:colptr[n+1]]."""
+    out_nb, out_ct, out_eids = [], [], []
+    for n in nodes:
+        beg, end = int(colptr[n]), int(colptr[n + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(beg, end)
+        else:
+            idx = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_nb.append(row[idx])
+        out_ct.append(len(idx))
+        if eids is not None:
+            out_eids.append(eids[idx])
+    nb = np.concatenate(out_nb) if out_nb else np.empty(0, np.int64)
+    es = (np.concatenate(out_eids) if out_eids else np.empty(0, np.int64)) \
+        if eids is not None else None
+    return nb, np.asarray(out_ct, np.int64), es
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Uniformly sample up to ``sample_size`` neighbors per input node
+    (host-side; -1 = all).  Returns (out_neighbors, out_count[, out_eids]).
+    """
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is True.")
+    r = _np1d(row)
+    cp = _np1d(colptr)
+    nodes = _np1d(input_nodes)
+    ea = _np1d(eids) if (eids is not None and return_eids) else None
+    rng = np.random.default_rng()
+    nb, ct, es = _sample_one_hop(r, cp, nodes, int(sample_size), ea, rng)
+    outs = (wrap(jnp.asarray(nb)), wrap(jnp.asarray(ct)))
+    if return_eids:
+        return outs + (wrap(jnp.asarray(es)),)
+    return outs
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-layer neighbor sampling + subgraph reindex (host-side).
+
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes[, edge_eids]),
+    edge columns shaped [E, 1] like the reference kernel
+    (paddle/fluid/operators/graph_khop_sampler_op.h).
+    """
+    if return_eids and sorted_eids is None:
+        raise ValueError(
+            "`sorted_eids` should not be None if `return_eids` is True.")
+    r = _np1d(row)
+    cp = _np1d(colptr)
+    seeds = _np1d(input_nodes)
+    ea = _np1d(sorted_eids) if (sorted_eids is not None and return_eids) \
+        else None
+    rng = np.random.default_rng()
+
+    frontier = seeds
+    all_src, all_dst, all_eids = [], [], []
+    for size in list(sample_sizes):
+        nb, ct, es = _sample_one_hop(r, cp, frontier, int(size), ea, rng)
+        all_src.append(nb)
+        all_dst.append(np.repeat(frontier, ct))
+        if es is not None:
+            all_eids.append(es)
+        # next layer samples neighbors of the newly discovered nodes
+        frontier = np.unique(nb)
+    src = np.concatenate(all_src) if all_src else np.empty(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.empty(0, np.int64)
+
+    # subgraph reindex: seeds first, then sampled nodes by first appearance
+    mapped_src, out_nodes = _reindex_np(seeds, src)
+    table = {int(v): i for i, v in enumerate(out_nodes)}
+    mapped_dst = np.asarray([table[int(v)] for v in dst], np.int64)
+    reindex_nodes = np.arange(len(seeds), dtype=np.int64)
+
+    outs = (
+        wrap(jnp.asarray(mapped_src.reshape(-1, 1))),
+        wrap(jnp.asarray(mapped_dst.reshape(-1, 1))),
+        wrap(jnp.asarray(out_nodes)),
+        wrap(jnp.asarray(reindex_nodes)),
+    )
+    if return_eids:
+        es = np.concatenate(all_eids) if all_eids else np.empty(0, np.int64)
+        return outs + (wrap(jnp.asarray(es.reshape(-1, 1))),)
+    return outs

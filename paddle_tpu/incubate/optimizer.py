@@ -1,0 +1,224 @@
+"""Incubate optimizers: LookAhead, ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py and
+modelaverage.py (+ the average_accumulates kernel,
+paddle/fluid/operators/average_accumulates_op.h).
+
+TPU-native design: both keep their state in persistent Tensors and express
+the every-k-step / window-reset conditions as ``jnp.where`` over a
+step-counter tensor rather than host control flow, so `step()` inside a
+``to_static`` train step compiles into the same XLA program as the inner
+optimizer update (the reference reaches the same shape via conditional
+blocks in ProgramDesc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    r"""Lookahead (https://arxiv.org/abs/1907.08610): the inner optimizer
+    updates fast params every step; every ``k`` steps the slow params move
+    ``alpha`` of the way to the fast params and the fast params snap back:
+
+        slow = slow + alpha * (fast - slow);  fast = slow
+
+    Reference: python/paddle/incubate/optimizer/lookahead.py:26.
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError(
+                "inner optimizer should be an Optimizer, but got "
+                f"{type(inner_optimizer)}")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1], but got %s" % alpha)
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k should be a positive integer, but got %s" % k)
+        super().__init__(
+            learning_rate=alpha,
+            parameters=inner_optimizer._parameter_list, name=name)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def _step_counter(self) -> Tensor:
+        from ..core import tensor as tensor_mod
+
+        accs = self._accumulators.setdefault("@lookahead", {})
+        if "k_step" not in accs:
+            accs["k_step"] = tensor_mod.external_tensor(
+                lambda: jnp.zeros((), jnp.int32))
+        return accs["k_step"]
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        ctr = self._step_counter()
+        step = ctr._value() + 1
+        ctr._set_data(step)
+        sync = (step % self.k) == 0
+        for p in self._parameter_list or []:
+            if not getattr(p, "trainable", True):
+                continue
+            slow = self._get_accumulator(
+                "slow", p, dtype=jnp.float32,
+                init_from=lambda p=p: p._data.astype(jnp.float32))
+            fast32 = self._master_value(p)
+            slow_new = jnp.where(
+                sync, slow._value() + self.alpha * (fast32 - slow._value()),
+                slow._value())
+            slow._set_data(slow_new)
+            self._apply_master(p, jnp.where(sync, slow_new, fast32))
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        sd = super().state_dict()
+        for k, v in self.inner_optimizer.state_dict().items():
+            sd[f"inner/{k}"] = v
+        return sd
+
+    def set_state_dict(self, state_dict):
+        inner = {k[len("inner/"):]: v for k, v in state_dict.items()
+                 if k.startswith("inner/")}
+        outer = {k: v for k, v in state_dict.items()
+                 if not k.startswith("inner/")}
+        self.inner_optimizer.set_state_dict(inner)
+        super().set_state_dict(outer)
+
+
+class ModelAverage(Optimizer):
+    r"""Maintain a running average of parameters over a trailing window and
+    swap it in for evaluation via ``apply()`` / ``restore()``.
+
+    The window length tracks
+    ``min(max_average_window, num_updates * average_window_rate)`` with a
+    floor of ``min_average_window``; the three-bucket sum scheme
+    (sum_1 current, sum_2 precision-rollover every 16384 updates, sum_3
+    last discarded window) follows the reference kernel exactly
+    (average_accumulates_op.h:42-108).
+    """
+
+    _MAX_NUM_ACCUMULATES = 16384
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters, name=name)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._restore_vals = {}
+
+    def _counter(self, name) -> Tensor:
+        from ..core import tensor as tensor_mod
+
+        accs = self._accumulators.setdefault("@model_average", {})
+        if name not in accs:
+            accs[name] = tensor_mod.external_tensor(
+                lambda: jnp.zeros((), jnp.int32))
+        return accs[name]
+
+    def _sums(self, p):
+        return tuple(
+            self._get_accumulator(n, p, dtype=jnp.float32)
+            for n in ("sum_1", "sum_2", "sum_3"))
+
+    @no_grad()
+    def step(self):
+        nu_t = self._counter("num_updates")
+        na_t = self._counter("num_accumulates")
+        ona_t = self._counter("old_num_accumulates")
+        num_updates = nu_t._value() + 1
+        num_accumulates = na_t._value() + 1
+
+        rollover = (num_updates % self._MAX_NUM_ACCUMULATES) == 0
+        window = jnp.minimum(
+            jnp.asarray(self.max_average_window, jnp.float32),
+            num_updates.astype(jnp.float32) * self.average_window)
+        discard = (num_accumulates >= self.min_average_window) \
+            & (num_accumulates.astype(jnp.float32) >= window)
+
+        for p in self._parameter_list or []:
+            if not getattr(p, "trainable", True):
+                continue
+            s1, s2, s3 = self._sums(p)
+            v1 = s1._value() + self._master_value(p)
+            v2, v3 = s2._value(), s3._value()
+            # precision rollover: fold sum_1 into sum_2
+            v2 = jnp.where(rollover, v2 + v1, v2)
+            v1 = jnp.where(rollover, jnp.zeros_like(v1), v1)
+            # window overflow: current window becomes the "old" sum
+            v3 = jnp.where(discard, v1 + v2, v3)
+            v1 = jnp.where(discard, jnp.zeros_like(v1), v1)
+            v2 = jnp.where(discard, jnp.zeros_like(v2), v2)
+            s1._set_data(v1)
+            s2._set_data(v2)
+            s3._set_data(v3)
+
+        ona_t._set_data(jnp.where(discard, num_accumulates, ona_t._value()))
+        na_t._set_data(jnp.where(discard, jnp.zeros_like(num_accumulates),
+                                 num_accumulates))
+        nu_t._set_data(num_updates)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, None
+
+    def _average_value(self, p):
+        s1, s2, s3 = self._sums(p)
+        total = self._counter("num_accumulates")._value() \
+            + self._counter("old_num_accumulates")._value()
+        denom = jnp.maximum(total, 1).astype(jnp.float32)
+        return (s1._value() + s2._value() + s3._value()) / denom
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Swap the averaged values into the parameters (eval-time)."""
+        for p in self._parameter_list or []:
+            if not getattr(p, "trainable", True):
+                continue
+            self._restore_vals[self._param_key(p)] = p._value()
+            self._apply(p, self._average_value(p))
+        self._need_restore = need_restore
+        return _ApplyCtx(self)
+
+    @no_grad()
+    def restore(self, executor=None):
+        """Undo ``apply()``: put the training values back."""
+        for p in self._parameter_list or []:
+            key = self._param_key(p)
+            if key in self._restore_vals:
+                p._set_data(self._restore_vals.pop(key))
+
+
+class _ApplyCtx:
+    """`with model_average.apply(): ...` restores on exit if requested."""
+
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self._ma, "_need_restore", True):
+            self._ma.restore()
+        return False

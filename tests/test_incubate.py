@@ -1,0 +1,293 @@
+"""incubate parity: graph ops, segment ops, fused softmax, LookAhead,
+ModelAverage (reference: python/paddle/incubate/__init__.py exports and the
+unittests test_graph_send_recv_op.py, test_segment_ops.py,
+test_lookahead.py, test_modelaverage.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# segment ops
+# ---------------------------------------------------------------------------
+
+def test_segment_sum_mean_max_min():
+    data = paddle.to_tensor(
+        [[1, 2, 3], [3, 2, 1], [4, 5, 6]], dtype="float32")
+    ids = paddle.to_tensor([0, 0, 1], dtype="int32")
+    np.testing.assert_allclose(
+        paddle.incubate.segment_sum(data, ids).numpy(),
+        [[4, 4, 4], [4, 5, 6]])
+    np.testing.assert_allclose(
+        paddle.incubate.segment_mean(data, ids).numpy(),
+        [[2, 2, 2], [4, 5, 6]])
+    np.testing.assert_allclose(
+        paddle.incubate.segment_max(data, ids).numpy(),
+        [[3, 2, 3], [4, 5, 6]])
+    np.testing.assert_allclose(
+        paddle.incubate.segment_min(data, ids).numpy(),
+        [[1, 2, 1], [4, 5, 6]])
+
+
+def test_segment_empty_segment_fills_zero():
+    data = paddle.to_tensor([[1.0, 2.0], [5.0, 3.0]])
+    ids = paddle.to_tensor([0, 2], dtype="int64")  # segment 1 empty
+    for fn in (paddle.incubate.segment_mean, paddle.incubate.segment_max,
+               paddle.incubate.segment_min):
+        out = fn(data, ids).numpy()
+        np.testing.assert_allclose(out[1], [0.0, 0.0])
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(
+        np.arange(6, dtype=np.float32).reshape(3, 2), stop_gradient=False)
+    ids = paddle.to_tensor([0, 0, 1], dtype="int32")
+    out = paddle.incubate.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 2)))
+
+
+# ---------------------------------------------------------------------------
+# graph ops
+# ---------------------------------------------------------------------------
+
+def test_graph_send_recv_sum_and_default_fill():
+    x = paddle.to_tensor([[0, 2, 3], [1, 4, 5], [2, 6, 7]], dtype="float32")
+    src = paddle.to_tensor([0, 1, 2, 0], dtype="int32")
+    dst = paddle.to_tensor([1, 2, 1, 0], dtype="int32")
+    out = paddle.incubate.graph_send_recv(x, src, dst, pool_type="sum")
+    np.testing.assert_allclose(
+        out.numpy(), [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+    # node receiving nothing -> 0 rows (reference example 3)
+    src2 = paddle.to_tensor([0, 2, 0], dtype="int32")
+    dst2 = paddle.to_tensor([1, 1, 0], dtype="int32")
+    out2 = paddle.incubate.graph_send_recv(x, src2, dst2, pool_type="max")
+    np.testing.assert_allclose(out2.numpy()[2], [0, 0, 0])
+
+
+def test_graph_send_recv_mean_out_size_grad():
+    x = paddle.to_tensor(
+        np.arange(9, dtype=np.float32).reshape(3, 3), stop_gradient=False)
+    src = paddle.to_tensor([0, 1, 2, 0], dtype="int32")
+    dst = paddle.to_tensor([1, 1, 0, 0], dtype="int32")
+    out = paddle.incubate.graph_send_recv(
+        x, src, dst, pool_type="mean", out_size=2)
+    assert out.shape == [2, 3]
+    out.sum().backward()
+    # each message contributes 1/count of its destination row
+    assert data_ok(x.grad.numpy())
+
+
+def data_ok(g):
+    expected = np.array(
+        [[0.5 + 0.5, 0.5 + 0.5, 0.5 + 0.5],  # src 0 -> dst 1 (cnt2), dst 0 (cnt2)
+         [0.5, 0.5, 0.5],
+         [0.5, 0.5, 0.5]], np.float32)
+    return np.allclose(g, expected)
+
+
+def test_graph_send_recv_bad_pool_type():
+    x = paddle.to_tensor([[1.0]])
+    idx = paddle.to_tensor([0], dtype="int32")
+    with pytest.raises(ValueError):
+        paddle.incubate.graph_send_recv(x, idx, idx, pool_type="prod")
+
+
+def test_graph_reindex():
+    x = paddle.to_tensor([0, 1, 2], dtype="int64")
+    neighbors = paddle.to_tensor([8, 9, 0, 4, 7, 6, 7], dtype="int64")
+    count = paddle.to_tensor([2, 3, 2], dtype="int32")
+    src, dst, nodes = paddle.incubate.graph_reindex(x, neighbors, count)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def _csc_graph():
+    # graph over 5 nodes; in-neighbors per node (CSC): row/colptr
+    # node0 <- {1, 2}; node1 <- {3}; node2 <- {0, 3, 4}; node3 <- {}; node4 <- {2}
+    row = np.array([1, 2, 3, 0, 3, 4, 2], np.int64)
+    colptr = np.array([0, 2, 3, 6, 6, 7], np.int64)
+    return row, colptr
+
+
+def test_graph_sample_neighbors_all_and_capped():
+    row, colptr = _csc_graph()
+    nodes = paddle.to_tensor([0, 2, 3], dtype="int64")
+    nb, ct = paddle.incubate.graph_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr), nodes,
+        sample_size=-1)
+    np.testing.assert_array_equal(ct.numpy(), [2, 3, 0])
+    np.testing.assert_array_equal(np.sort(nb.numpy()[:2]), [1, 2])
+    # capped sampling returns at most sample_size per node, all valid
+    nb2, ct2 = paddle.incubate.graph_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr), nodes,
+        sample_size=2)
+    assert list(ct2.numpy()) == [2, 2, 0]
+    assert set(nb2.numpy()[2:4]) <= {0, 3, 4}
+
+
+def test_graph_khop_sampler_shapes_and_validity():
+    row, colptr = _csc_graph()
+    seeds = paddle.to_tensor([0, 4], dtype="int64")
+    src, dst, sample_index, reindex_nodes = paddle.incubate.graph_khop_sampler(
+        paddle.to_tensor(row), paddle.to_tensor(colptr), seeds, [2, 2])
+    src, dst = src.numpy(), dst.numpy()
+    nodes = sample_index.numpy()
+    assert src.shape[1] == 1 and dst.shape[1] == 1
+    assert src.shape[0] == dst.shape[0] > 0
+    # seeds occupy the first slots, reindex_nodes points at them
+    np.testing.assert_array_equal(nodes[:2], [0, 4])
+    np.testing.assert_array_equal(reindex_nodes.numpy(), [0, 1])
+    # every edge endpoint is a valid local id
+    assert src.max() < len(nodes) and dst.max() < len(nodes)
+    # each reindexed edge corresponds to a real graph edge dst<-src
+    edges = {(int(colv), int(r)) for colv in range(5)
+             for r in row[colptr[colv]:colptr[colv + 1]]}
+    for s, d in zip(src[:, 0], dst[:, 0]):
+        assert (int(nodes[d]), int(nodes[s])) in edges
+
+
+def test_graph_khop_sampler_return_eids():
+    row, colptr = _csc_graph()
+    eids = np.arange(len(row), dtype=np.int64)
+    seeds = paddle.to_tensor([2], dtype="int64")
+    out = paddle.incubate.graph_khop_sampler(
+        paddle.to_tensor(row), paddle.to_tensor(colptr), seeds, [3],
+        sorted_eids=paddle.to_tensor(eids), return_eids=True)
+    assert len(out) == 5
+    es = out[4].numpy()
+    assert es.shape[1] == 1
+    assert set(es[:, 0]) <= {3, 4, 5}  # node2's in-edges
+
+
+# ---------------------------------------------------------------------------
+# fused softmax
+# ---------------------------------------------------------------------------
+
+def test_softmax_mask_fuse():
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 4, 8, 32).astype(np.float32)
+    mask = (rs.rand(2, 1, 8, 32) > 0.5).astype(np.float32) * -10000.0
+    out = paddle.incubate.softmax_mask_fuse(
+        paddle.to_tensor(x), paddle.to_tensor(mask))
+
+    def ref_softmax(v):
+        e = np.exp(v - v.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    np.testing.assert_allclose(
+        out.numpy(), ref_softmax(x + mask), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    rs = np.random.RandomState(1)
+    x = rs.rand(1, 2, 6, 6).astype(np.float32)
+    out = paddle.incubate.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(x)).numpy()
+    # rows sum to 1, strictly-upper entries ~0
+    np.testing.assert_allclose(out.sum(-1), np.ones((1, 2, 6)), rtol=1e-5)
+    iu = np.triu_indices(6, k=1)
+    assert out[0, 0][iu].max() < 1e-4
+    # masked softmax equals softmax over the unmasked prefix
+    ref = np.exp(x[0, 0, 3, :4] - x[0, 0, 3, :4].max())
+    ref = ref / ref.sum()
+    np.testing.assert_allclose(out[0, 0, 3, :4], ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# LookAhead / ModelAverage
+# ---------------------------------------------------------------------------
+
+def _tiny_net():
+    paddle.seed(7)
+    return paddle.nn.Linear(4, 3)
+
+
+def test_lookahead_sync_every_k():
+    net = _tiny_net()
+    w0 = net.weight.numpy().copy()
+    inner = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    fast = w0.copy()
+    slow = None
+    for i in range(1, 5):
+        loss = net(x).mean()
+        loss.backward()
+        g = net.weight.grad.numpy()
+        opt.step()
+        opt.clear_grad()
+        fast = fast - 0.1 * g
+        if slow is None:
+            # reference contract (lookahead.py:228): slow is seeded from
+            # the fast param at the first step, after the inner update
+            slow = fast.copy()
+        if i % 2 == 0:
+            slow = slow + 0.5 * (fast - slow)
+            fast = slow.copy()
+        np.testing.assert_allclose(
+            net.weight.numpy(), fast, rtol=1e-5, atol=1e-6)
+
+
+def test_lookahead_validation():
+    net = _tiny_net()
+    inner = paddle.optimizer.SGD(parameters=net.parameters())
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(inner, alpha=2.0)
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(inner, k=0)
+
+
+def test_model_average_apply_restore():
+    net = _tiny_net()
+    sgd = paddle.optimizer.SGD(
+        learning_rate=0.05, parameters=net.parameters())
+    ma = paddle.incubate.ModelAverage(
+        0.15, parameters=net.parameters(),
+        min_average_window=2, max_average_window=10)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    seen = []
+    for _ in range(4):
+        loss = net(x).mean()
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        ma.step()
+        seen.append(net.weight.numpy().copy())
+
+    w_train = net.weight.numpy().copy()
+    with ma.apply():
+        # window math: after 4 steps with min_window=2 and
+        # rate 0.15 (window=ceil-ish small), discards happened; the
+        # invariant we check is that apply() swaps in the mean of SOME
+        # trailing window of the seen values and restore() undoes it.
+        w_avg = net.weight.numpy().copy()
+        assert not np.allclose(w_avg, w_train)
+        lo = np.minimum.reduce(seen)
+        hi = np.maximum.reduce(seen)
+        assert np.all(w_avg >= lo - 1e-6) and np.all(w_avg <= hi + 1e-6)
+    np.testing.assert_allclose(net.weight.numpy(), w_train, rtol=1e-6)
+
+
+def test_model_average_window_average_exact():
+    # with min_average_window=1 and rate=1.0 the window never discards
+    # during the first steps until num_accumulates >= num_updates*1.0 —
+    # i.e. it discards every step; old window then holds the running sum.
+    net = _tiny_net()
+    ma = paddle.incubate.ModelAverage(
+        1.0, parameters=net.parameters(),
+        min_average_window=10000, max_average_window=10000)
+    vals = []
+    for i in range(3):
+        net.weight._set_data(net.weight._value() * 0 + float(i + 1))
+        ma.step()
+        vals.append(float(i + 1))
+    with ma.apply():
+        np.testing.assert_allclose(
+            net.weight.numpy(),
+            np.full((4, 3), np.mean(vals), np.float32), rtol=1e-6)
