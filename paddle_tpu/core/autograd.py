@@ -65,7 +65,7 @@ class TapeNode:
     collected by the python GC once user refs drop)."""
 
     __slots__ = ("vjp_fn", "inputs", "outputs", "name", "released",
-                 "materialize", "input_edges")
+                 "materialize", "input_edges", "__weakref__")
 
     def __init__(self, vjp_fn, inputs, outputs, name="", materialize=True):
         self.vjp_fn = vjp_fn

@@ -9,6 +9,7 @@ any differentiable input requires grad) and records one TapeNode.
 """
 from __future__ import annotations
 
+import weakref
 from typing import Any, Callable, List, Sequence
 
 import numpy as np
@@ -110,6 +111,18 @@ def apply_op(
     )
     for t in outs_list:
         t._grad_node = node
+    node_ref = weakref.ref(node)
+    for i in diff_idx:
+        t = tensor_args[i]
+        lst = t._consumers
+        if lst is None:
+            lst = t._consumers = []
+        lst.append(node_ref)
+        # amortized prune: long-lived tensors (parameters) would otherwise
+        # accumulate one dead weakref per consuming op forever
+        n = len(lst)
+        if n >= 64 and (n & (n - 1)) == 0:
+            t._consumers = [r for r in lst if r() is not None]
     if _static_record_hook is not None:
         _static_record_hook(name, primal, tensor_args, kwargs,
                             tuple(outs_list))

@@ -48,6 +48,10 @@ class Tensor:
         # identity, never id() — ids of dead tensors get reused)
         "_trace_born",
         "_trace_grad",
+        # weakrefs to TapeNodes that consumed this tensor; an in-place op
+        # retargets their input entries to the pre-in-place shadow so
+        # already-recorded backwards keep routing to the old value
+        "_consumers",
         "__weakref__",
     )
 
@@ -69,6 +73,7 @@ class Tensor:
         self._backward_hooks = None
         self._trace_born = None
         self._trace_grad = None
+        self._consumers = None
         h = _trace_hook
         if h is not None:
             h.mark_created(self)
@@ -87,6 +92,7 @@ class Tensor:
         t._backward_hooks = None
         t._trace_born = None
         t._trace_grad = None
+        t._consumers = None
         h = _trace_hook
         if h is not None:
             h.mark_created(t)
@@ -293,6 +299,7 @@ class Tensor:
             shadow._backward_hooks = None
             shadow._trace_born = None
             shadow._trace_grad = None
+            shadow._consumers = None
             if old_node is None and not old_stop:
                 # leaf requiring grad: cotangents for the pre-in-place
                 # value must land on THIS tensor's .grad (reference
@@ -310,6 +317,24 @@ class Tensor:
                                     for o in old_node.outputs]
             node.inputs = [shadow if t is self else t
                            for t in node.inputs]
+            # every EARLIER consumer of `self` recorded the pre-in-place
+            # value (vjp residuals are captured by value at forward time),
+            # so their backward must deliver cotangents to the old autograd
+            # position — retarget their input entries to the shadow
+            # (reference: torch's version-counter raises here; capturing by
+            # value lets us keep these programs valid AND correct)
+            if self._consumers:
+                live = []
+                for ref in self._consumers:
+                    n = ref()
+                    if n is None or n.released:
+                        continue
+                    if n is not node:
+                        n.inputs = [shadow if t is self else t
+                                    for t in n.inputs]
+                    else:
+                        live.append(ref)
+                self._consumers = live or None
         self._set_data(out._value())
         self._version += 1     # stale backward reads now raise
         self._grad_node = node
@@ -466,4 +491,5 @@ def external_tensor(value, dtype=None) -> Tensor:
     t._backward_hooks = None
     t._trace_born = None
     t._trace_grad = None
+    t._consumers = None
     return t

@@ -102,6 +102,27 @@ def distributed_model(model):
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     hcg = get_hybrid_communicate_group()
     s = strategy or _user_strategy
+    if s is not None and getattr(s, "lars", False):
+        # reference LarsOptimizer meta rule: applies only over a Momentum
+        # inner optimizer, replacing its update with lars_momentum
+        from ...optimizer.optimizer import Lars, Momentum
+
+        if isinstance(optimizer, Momentum):
+            cfg = s.lars_configs
+            optimizer = Lars(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                lars_coeff=cfg.lars_coeff,
+                lars_weight_decay=cfg.lars_weight_decay,
+                epsilon=cfg.epsilon,
+                exclude_from_weight_decay=cfg.exclude_from_weight_decay,
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip)
+        elif not isinstance(optimizer, Lars):
+            raise ValueError(
+                "strategy.lars requires a Momentum inner optimizer "
+                "(reference lars_optimizer._can_apply); construct "
+                "paddle.optimizer.Lars directly for other cases")
     opt = HybridParallelOptimizer(optimizer, hcg, s)
     if s is not None and getattr(s, "gradient_merge", False):
         from .meta_optimizers import GradientMergeOptimizer
@@ -113,6 +134,10 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
 
         cfg = s.localsgd_configs
         opt = LocalSGDOptimizer(opt, k_steps=cfg.k_steps)
+    if s is not None and getattr(s, "fp16_allreduce", False):
+        from .meta_optimizers import FP16AllReduceOptimizer
+
+        opt = FP16AllReduceOptimizer(opt)
     if s is not None and getattr(s, "dgc", False):
         raise ValueError(
             "strategy.dgc: construct DGCMomentumOptimizer directly (it "

@@ -11,9 +11,9 @@ TPU notes on the reference set:
   bandwidth-bound, so the win here is the *semantics* (sparse updates)
   rather than comm compression — the reference's CUDA encode/decode
   stages collapse into a mask.
-- fp16-allreduce: subsumed by AMP-O2 (grads are already bf16 on the
-  wire under autocast).
-- LARS/LAMB: plain optimizers (optimizer/optimizer.py Lamb).
+- fp16-allreduce: FP16AllReduceOptimizer (below) — under AMP-O2 grads
+  are already bf16 on the wire, so it matters for f32 training only.
+- LARS/LAMB: plain optimizers (optimizer/optimizer.py Lars/Lamb).
 - ASP (2:4 structured sparsity) lives at paddle.incubate.asp.
 """
 from __future__ import annotations
@@ -23,7 +23,7 @@ import jax
 from ...core.tensor import Tensor
 
 __all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer",
-           "DGCMomentumOptimizer"]
+           "DGCMomentumOptimizer", "FP16AllReduceOptimizer"]
 
 
 class GradientMergeOptimizer:
@@ -213,6 +213,7 @@ class DGCMomentumOptimizer:
         self._u = {}
         self._v = {}
         self._momentum = momentum
+        self._use_nesterov = bool(use_nesterov)
 
     def _cur_sparsity(self):
         if self._step_count < self._rampup_begin:
@@ -251,8 +252,13 @@ class DGCMomentumOptimizer:
                 u = vel._value().astype(jnp.float32) if vel is not None \
                     else jnp.zeros_like(garr)
                 v = jnp.zeros_like(garr)
-            u = m * u + garr                  # momentum correction
-            v = v + u                         # local accumulation
+            if self._use_nesterov:
+                # reference dgc_op.h:155: u = m*(u+g); v = v + u + g
+                u = m * (u + garr)
+                v = v + u + garr
+            else:
+                u = m * u + garr              # momentum correction
+                v = v + u                     # local accumulation
             k = max(int(v.size * (1.0 - sparsity)), 1)
             flat = jnp.abs(v).reshape(-1)
             thresh = jax.lax.top_k(flat, k)[0][-1]
@@ -308,3 +314,47 @@ class DGCMomentumOptimizer:
 
     def __getattr__(self, name):
         return getattr(self._inner_opt, name)
+
+
+class FP16AllReduceOptimizer:
+    """Compress f32 gradients to fp16 across the data-parallel all-reduce
+    (reference: meta_optimizers/fp16_allreduce_optimizer.py:20 — cast
+    fp32→fp16 before c_allreduce_sum, cast back after).
+
+    TPU-native shape: under jit the DP all-reduce is the XLA psum that
+    GSPMD inserts over the grad, so "compress the wire" = make the tensor
+    crossing the collective fp16.  This wrapper applies the same
+    cast-down/cast-up pair around the gradient before the inner update;
+    inside a compiled train step XLA places the psum between the two casts
+    (the fp16 tensor is what rides ICI), and in eager multi-controller use
+    the quantization semantics match the reference exactly.  Gradients
+    already in fp16/bf16 are left alone, like the reference's dtype filter.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def step(self):
+        import jax.numpy as jnp
+
+        for p in (self._inner._parameter_list or []):
+            g = p.grad
+            if g is None:
+                continue
+            garr = g._value() if isinstance(g, Tensor) else g
+            if garr.dtype == jnp.float32:
+                p.grad = garr.astype(jnp.float16).astype(jnp.float32)
+        self._inner.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None

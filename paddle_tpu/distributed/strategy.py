@@ -77,6 +77,14 @@ class DGCConfig:
 
 
 @dataclass
+class LarsConfig:
+    lars_coeff: float = 0.001
+    lars_weight_decay: float = 0.0005
+    epsilon: float = 0.0
+    exclude_from_weight_decay: List[str] = field(default_factory=list)
+
+
+@dataclass
 class MoEConfig:
     enable: bool = False
     num_experts: int = 1
@@ -109,6 +117,9 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.localsgd = False
         self.dgc = False
+        self.fp16_allreduce = False
+        self.lars = False
+        self.lars_configs = LarsConfig()
         self.find_unused_parameters = False
 
     def __setattr__(self, name, value):

@@ -67,15 +67,23 @@ class LookAhead(Optimizer):
         for p in self._parameter_list or []:
             if not getattr(p, "trainable", True):
                 continue
+            # copy=True: astype on an f32 param would alias the param's
+            # buffer and break donation under jit (same buffer donated
+            # twice as two state entries)
             slow = self._get_accumulator(
                 "slow", p, dtype=jnp.float32,
-                init_from=lambda p=p: p._data.astype(jnp.float32))
-            fast32 = self._master_value(p)
+                init_from=lambda p=p: jnp.array(
+                    p._data, dtype=jnp.float32, copy=True))
+            # read/write the FAST weights through the INNER optimizer's
+            # master accumulator: under AMP-O2 a private master here would
+            # freeze at its init value and desync from the inner updates
+            fast32 = self.inner_optimizer._master_value(p)
             slow_new = jnp.where(
                 sync, slow._value() + self.alpha * (fast32 - slow._value()),
                 slow._value())
             slow._set_data(slow_new)
-            self._apply_master(p, jnp.where(sync, slow_new, fast32))
+            self.inner_optimizer._apply_master(
+                p, jnp.where(sync, slow_new, fast32))
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -159,7 +167,9 @@ class ModelAverage(Optimizer):
             if not getattr(p, "trainable", True):
                 continue
             s1, s2, s3 = self._sums(p)
-            v1 = s1._value() + self._master_value(p)
+            # accumulate the CURRENT param value (the main optimizer owns
+            # any master copy; a private master here would freeze)
+            v1 = s1._value() + p._value().astype(jnp.float32)
             v2, v3 = s2._value(), s3._value()
             # precision rollover: fold sum_1 into sum_2
             v2 = jnp.where(rollover, v2 + v1, v2)

@@ -1,6 +1,6 @@
 """paddle.optimizer surface (reference: python/paddle/optimizer)."""
 from .optimizer import (
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp,
-    Adadelta, Lamb,
+    Adadelta, Lamb, Lars, LarsMomentumOptimizer,
 )
 from . import lr

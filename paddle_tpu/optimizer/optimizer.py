@@ -441,6 +441,58 @@ class Adadelta(Optimizer):
         self._apply_master(p, self._master_value(p) + lr * upd)
 
 
+class Lars(Optimizer):
+    """LARS momentum (reference: fluid/optimizer.py:1969
+    LarsMomentumOptimizer; kernel lars_momentum_op.h):
+
+        local_lr = lr * lars_coeff * ||p|| / (eps + ||g|| + wd * ||p||)
+        velocity = mu * velocity + local_lr * (g + wd * p)
+        p       -= velocity
+
+    Layers whose name matches ``exclude_from_weight_decay`` skip the decay
+    term (both in local_lr and the velocity update), like the reference's
+    name-substring match.
+    """
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = float(momentum)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_weight_decay = float(lars_weight_decay)
+        self._epsilon = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._rescale_grad = float(rescale_grad)
+
+    def _update_param(self, p, g):
+        lr = self._lr_array()
+        g32 = g.astype(jnp.float32) * self._rescale_grad
+        p32 = self._master_value(p)
+        wd = self._lars_weight_decay
+        pname = p.name or ""
+        if any(tok in pname for tok in self._exclude):
+            wd = 0.0
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        # reference kernel guard: fall back to plain lr when either norm
+        # is zero (fresh zero-init params would otherwise stall at 0)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm
+            / (self._epsilon + g_norm + wd * p_norm),
+            lr)
+        vel = self._get_accumulator("velocity", p, dtype=jnp.float32)
+        v_new = self._momentum * vel._value() + local_lr * (g32 + wd * p32)
+        vel._set_data(v_new)
+        self._apply_master(p, p32 - v_new)
+
+
+# reference class name (fluid/optimizer.py:1969)
+LarsMomentumOptimizer = Lars
+
+
 class Lamb(Optimizer):
     """Layer-wise adaptive moments (reference: optimizer/lamb.py; the
     distributed_fused_lamb op family collapses to this math under jit)."""

@@ -104,6 +104,11 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
             a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
                         mode=pad_mode)
         n = a.shape[-1]
+        if n < n_fft:
+            raise ValueError(
+                f"Input frame size should be less or equal than signal "
+                f"frame size ({n}), but got: {n_fft}. (with center={center} "
+                f"the signal is padded by n_fft//2 on both sides first)")
         n_frames = 1 + (n - n_fft) // hop_length
         starts = np.arange(n_frames) * hop_length
         idx = starts[:, None] + np.arange(n_fft)[None, :]

@@ -73,13 +73,21 @@ class Program:
         self._name_locator: Dict[str, tuple] = {}
         self._declared_shapes: Dict[str, list] = {}
         self._cache = {}
+        self._n_post_run = 0   # ops dispatched (and dropped) after finalize
 
     # -- recording ------------------------------------------------------
     def _record(self, name, primal, tensor_args, kwargs, outs):
         if self._ssa is not None:
-            raise RuntimeError(
-                "Program was already executed; build a new Program "
-                "instead of appending ops after Executor.run")
+            # Ops dispatched after Executor.run finalized this program are
+            # between-runs eager computations (LR schedules, metrics built
+            # with paddle ops).  They already executed through dispatch and
+            # their values are live on the output Tensors — drop the
+            # recording (keeping it would pin every intermediate array for
+            # the life of the program; the reference re-lowers the whole
+            # ProgramDesc on append instead).  Fetching such a tensor from
+            # this program still errors by identity validation.
+            self._n_post_run += 1
+            return
         self._raw.append(_RawOp(name, primal, list(tensor_args),
                                 dict(kwargs), list(outs)))
         self._cache.clear()

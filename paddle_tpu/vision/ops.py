@@ -85,18 +85,45 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             rh = jnp.maximum(rh, 1.0)
         bin_h = rh / ph
         bin_w = rw / pw
-        sr_h = sampling_ratio if sampling_ratio > 0 else int(
-            np.ceil(feat.shape[2] / ph))
-        sr_w = sampling_ratio if sampling_ratio > 0 else int(
-            np.ceil(feat.shape[3] / pw))
+        # Static sample-grid size (XLA needs fixed shapes).  With
+        # sampling_ratio=-1 the reference uses PER-RoI adaptive counts
+        # ceil(roi_h/pooled_h) (roi_align_kernel.h:278): we allocate an
+        # upper-bound grid sized from the actual boxes (concrete in eager;
+        # proposals can overshoot the feature map) and mask samples beyond
+        # each RoI's own count, averaging over the actual count —
+        # numerically identical to the per-RoI grid.
+        if sampling_ratio > 0:
+            sr_h = sr_w = int(sampling_ratio)
+        else:
+            try:
+                b = np.asarray(rois, np.float64) * spatial_scale
+                sr_h = int(np.ceil((b[:, 3] - b[:, 1]).max() / ph))
+                sr_w = int(np.ceil((b[:, 2] - b[:, 0]).max() / pw))
+            except jax.errors.TracerArrayConversionError:
+                # traced boxes: fall back to the feature-map bound
+                # (exact for any RoI inside the map)
+                sr_h = int(np.ceil(feat.shape[2] / ph))
+                sr_w = int(np.ceil(feat.shape[3] / pw))
+            sr_h = max(sr_h, 1)
+            sr_w = max(sr_w, 1)
 
-        # sample grid per box: [ph, sr_h] x [pw, sr_w]
-        gy = (jnp.arange(ph)[:, None] +
-              (jnp.arange(sr_h)[None, :] + 0.5) / sr_h)   # [ph, sr_h]
-        gx = (jnp.arange(pw)[:, None] +
-              (jnp.arange(sr_w)[None, :] + 0.5) / sr_w)   # [pw, sr_w]
+        if sampling_ratio > 0:
+            n_h = jnp.full_like(bin_h, sr_h)
+            n_w = jnp.full_like(bin_w, sr_w)
+        else:
+            n_h = jnp.clip(jnp.ceil(bin_h), 1, sr_h)
+            n_w = jnp.clip(jnp.ceil(bin_w), 1, sr_w)
 
-        def per_box(b, feat_b, y0, x0, bh, bw):
+        iy = jnp.arange(sr_h)
+        ix = jnp.arange(sr_w)
+
+        def per_box(b, feat_b, y0, x0, bh, bw, nh, nw):
+            # sub-bin offsets for THIS box's sample count; entries with
+            # index >= n are masked out of the average
+            sub_y = (iy + 0.5) / nh                        # [sr_h]
+            sub_x = (ix + 0.5) / nw                        # [sr_w]
+            gy = (jnp.arange(ph)[:, None] + sub_y[None, :])  # [ph, sr_h]
+            gx = (jnp.arange(pw)[:, None] + sub_x[None, :])  # [pw, sr_w]
             ys = y0 + gy.reshape(-1) * bh                  # [ph*sr_h]
             xs = x0 + gx.reshape(-1) * bw                  # [pw*sr_w]
             yy = jnp.broadcast_to(ys[:, None],
@@ -105,10 +132,13 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                                   (ph * sr_h, pw * sr_w))
             s = _bilinear_gather(feat_b, yy, xx)           # [C, phs, pws]
             s = s.reshape(feat_b.shape[0], ph, sr_h, pw, sr_w)
-            return s.mean(axis=(2, 4))                     # [C, ph, pw]
+            mask = ((iy < nh)[:, None] & (ix < nw)[None, :])
+            s = s * mask[None, None, :, None, :].astype(s.dtype)
+            return s.sum(axis=(2, 4)) / (nh * nw)          # [C, ph, pw]
 
         feats = feat[bidx]                                 # [R, C, H, W]
-        return jax.vmap(per_box)(bidx, feats, y1, x1, bin_h, bin_w)
+        return jax.vmap(per_box)(bidx, feats, y1, x1, bin_h, bin_w,
+                                 n_h, n_w)
 
     return op("roi_align", _primal, [x, boxes])
 

@@ -291,3 +291,26 @@ def test_model_average_window_average_exact():
         np.testing.assert_allclose(
             net.weight.numpy(),
             np.full((4, 3), np.mean(vals), np.float32), rtol=1e-6)
+
+
+def test_lookahead_amp_o2_shares_inner_master():
+    """Under AMP-O2 (bf16 params + f32 masters) LookAhead must read/write
+    the inner optimizer's master weights, not fork its own (code-review
+    r4): training would otherwise pin the param at its init value."""
+    import paddle_tpu as paddle
+
+    net = _tiny_net()
+    inner = paddle.optimizer.SGD(
+        learning_rate=0.5, parameters=net.parameters())
+    net2, inner = paddle.amp.decorate(net, optimizers=inner, level="O2")
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    w_prev = net.weight.numpy().astype(np.float32).copy()
+    moved = []
+    for _ in range(3):
+        net.weight.grad = paddle.to_tensor(
+            np.ones(net.weight.shape, np.float32))
+        opt.step()
+        w_now = net.weight.numpy().astype(np.float32)
+        moved.append(not np.allclose(w_now, w_prev))
+        w_prev = w_now.copy()
+    assert all(moved), "weights stopped moving under O2 + LookAhead"

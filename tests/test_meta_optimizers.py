@@ -280,3 +280,41 @@ class TestFleetFS:
 
         with pytest.raises(NotImplementedError):
             HDFSClient("/opt/hadoop")
+
+
+class TestFP16AllReduce:
+    """Reference: meta_optimizers/fp16_allreduce_optimizer.py:20 — grads
+    cross the DP all-reduce as fp16."""
+
+    def test_grad_quantized_to_fp16(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            FP16AllReduceOptimizer)
+
+        net = paddle.nn.Linear(4, 4)
+        inner = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=net.parameters())
+        o = FP16AllReduceOptimizer(inner)
+        w0 = net.weight.numpy().copy()
+        g = np.full((4, 4), 0.1000123, np.float32)  # not fp16-representable
+        net.weight.grad = paddle.to_tensor(g)
+        net.bias.grad = paddle.to_tensor(np.zeros((4,), np.float32))
+        o.step()
+        g16 = g.astype(np.float16).astype(np.float32)
+        np.testing.assert_allclose(net.weight.numpy(), w0 - g16,
+                                   rtol=0, atol=1e-7)
+        assert not np.allclose(net.weight.numpy(), w0 - g)
+
+    def test_strategy_wiring(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            FP16AllReduceOptimizer)
+
+        s = paddle.distributed.DistributedStrategy()
+        s.fp16_allreduce = True
+        fleet.init(is_collective=True, strategy=s)
+        net = fleet.distributed_model(paddle.nn.Linear(2, 2))
+        o = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(parameters=net.parameters()), strategy=s)
+        assert isinstance(o, FP16AllReduceOptimizer)

@@ -327,20 +327,34 @@ class TestInplaceVariantsAndLinalgTail:
             float(paddle.cond(d, p='fro')),
             np.linalg.cond(np.diag([4.0, 2.0]), 'fro'), rtol=1e-5)
 
-    def test_stale_inplace_read_raises(self):
-        """An op recorded BEFORE an in-place mutation of its input must
-        refuse to backprop (reference inplace version counter,
-        dense_tensor.h:177)."""
+    def test_backward_through_inplace_consumers(self):
+        """Ops recorded BEFORE an in-place mutation of their input keep
+        correct gradients: vjp residuals are captured by value at forward
+        time and the in-place rebind retargets earlier consumers to the
+        pre-in-place shadow (where the reference's inplace version counter,
+        dense_tensor.h:177, would raise, we stay valid AND correct)."""
         import numpy as np
-        import pytest as _pt
 
         x = paddle.to_tensor(np.array([4.0], np.float32),
                              stop_gradient=False)
         a = x * 1
         b = a * 2          # consumes pre-in-place `a`
-        a.sqrt_()
-        with _pt.raises(RuntimeError, match="in-place"):
-            (b + a).sum().backward()
+        a.sqrt_()          # a becomes sqrt(x)
+        (b + a).sum().backward()
+        # d/dx [2x + sqrt(x)] = 2 + 0.5/sqrt(4) = 2.25
+        np.testing.assert_allclose(x.grad.numpy(), [2.25], rtol=1e-6)
+
+    def test_inplace_on_leaf_after_consume(self):
+        """y = x*2; x.add_(1): grad still reaches the leaf (ADVICE r3)."""
+        import numpy as np
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = x * 2
+        x.add_(paddle.to_tensor(np.array([1.0], np.float32)))
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        np.testing.assert_allclose(x.numpy(), [4.0])
 
     def test_lu_unpack_batched(self):
         import numpy as np

@@ -178,16 +178,27 @@ class TestReviewRegressions:
                                   feed={'x': np.zeros(2, np.float32)},
                                   fetch_list=["nope"])
 
-    def test_append_after_run_rejected(self, static_mode):
+    def test_append_after_run_routes_to_scratch(self, static_mode):
+        """Ops dispatched after Executor.run finalized the program no
+        longer raise (ADVICE r3: LR-schedule/metric ops between run()
+        calls) — they record into a detached scratch program; fetching
+        them from the executed program still errors."""
         main = static.Program()
         with static.program_guard(main):
             x = static.data('x', [2], 'float32')
             y = x * 2
         static.Executor().run(main, feed={'x': np.ones(2, np.float32)},
                               fetch_list=[y])
-        with pytest.raises(RuntimeError):
-            with static.program_guard(main):
-                _ = x + 1
+        with static.program_guard(main):
+            z = x + 1    # must not raise
+        assert main._n_post_run == 1
+        with pytest.raises(KeyError):
+            static.Executor().run(main, feed={'x': np.ones(2, np.float32)},
+                                  fetch_list=[z])
+        # the original program stays replayable
+        out, = static.Executor().run(
+            main, feed={'x': np.full(2, 3.0, np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(np.asarray(out), [6.0, 6.0])
 
     def test_intermediates_released_after_finalize(self, static_mode):
         import gc

@@ -190,3 +190,90 @@ class TestConvNormActivation:
         out = blk(x)
         assert tuple(out.shape) == (2, 8, 8, 8)
         assert float(out.min()) >= 0  # ReLU at the end
+
+
+def _roi_align_ref(feat, boxes, output_size, spatial_scale=1.0,
+                   sampling_ratio=-1, aligned=True):
+    """Brute-force reference with the kernel's PER-RoI adaptive sample
+    counts (roi_align_kernel.h:278: ceil(roi_h / pooled_h))."""
+    C, H, W = feat.shape[1:]
+    ph = pw = output_size
+
+    def interp(fb, y, x):
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        out = np.zeros(C, np.float64)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xx = y0 + dy, x0 + dx
+                if 0 <= yy < H and 0 <= xx < W:
+                    wy = (1 - abs(y - yy))
+                    wx = (1 - abs(x - xx))
+                    out += fb[:, yy, xx] * wy * wx
+        return out
+
+    outs = np.zeros((len(boxes), C, ph, pw), np.float64)
+    off = 0.5 if aligned else 0.0
+    for bi, (x1, y1, x2, y2) in enumerate(boxes):
+        x1, y1 = x1 * spatial_scale - off, y1 * spatial_scale - off
+        x2, y2 = x2 * spatial_scale - off, y2 * spatial_scale - off
+        rh, rw = y2 - y1, x2 - x1
+        bh, bw = rh / ph, rw / pw
+        nh = sampling_ratio if sampling_ratio > 0 else max(
+            int(np.ceil(bh)), 1)
+        nw = sampling_ratio if sampling_ratio > 0 else max(
+            int(np.ceil(bw)), 1)
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C, np.float64)
+                for iy in range(nh):
+                    for ix in range(nw):
+                        y = y1 + (i + (iy + 0.5) / nh) * bh
+                        x = x1 + (j + (ix + 0.5) / nw) * bw
+                        acc += interp(feat[0], y, x)
+                outs[bi, :, i, j] = acc / (nh * nw)
+    return outs.astype(np.float32)
+
+
+class TestRoIAlignAdaptiveSampling:
+    """sampling_ratio=-1 must use PER-RoI adaptive counts (ADVICE r3;
+    reference roi_align_kernel.h:278), not a grid derived from the
+    feature-map size."""
+
+    def test_small_roi_matches_per_roi_reference(self):
+        # feature with a kink at y=3 so over-sampling inside a bin gives a
+        # DIFFERENT answer than the correct single center sample
+        feat = np.abs(np.arange(8, dtype=np.float32) - 3.0)
+        feat = np.broadcast_to(feat[:, None], (8, 8)).copy()
+        feat = feat[None, None]                       # [1, 1, 8, 8]
+        boxes = np.array([[0.5, 0.5, 4.5, 4.5]], np.float32)  # 4x4 roi
+        out = _np(V.roi_align(
+            paddle.to_tensor(feat), paddle.to_tensor(boxes),
+            paddle.to_tensor(np.array([1], np.int32)),
+            output_size=4, sampling_ratio=-1))
+        ref = _roi_align_ref(feat, boxes, 4, sampling_ratio=-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_mixed_roi_sizes(self):
+        rs = np.random.RandomState(2)
+        feat = rs.randn(1, 2, 12, 12).astype(np.float32)
+        boxes = np.array([[0, 0, 11, 11],      # big: 3 samples/bin
+                          [2, 2, 4.5, 7],      # small: adaptive per-axis
+                          [5, 5, 5.8, 5.9]],   # tiny: 1 sample/bin
+                         np.float32)
+        out = _np(V.roi_align(
+            paddle.to_tensor(feat), paddle.to_tensor(boxes),
+            paddle.to_tensor(np.array([3], np.int32)),
+            output_size=4, sampling_ratio=-1))
+        ref = _roi_align_ref(feat, boxes, 4, sampling_ratio=-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_explicit_ratio_unchanged(self):
+        rs = np.random.RandomState(3)
+        feat = rs.randn(1, 1, 8, 8).astype(np.float32)
+        boxes = np.array([[1, 1, 6, 6]], np.float32)
+        out = _np(V.roi_align(
+            paddle.to_tensor(feat), paddle.to_tensor(boxes),
+            paddle.to_tensor(np.array([1], np.int32)),
+            output_size=2, sampling_ratio=2))
+        ref = _roi_align_ref(feat, boxes, 2, sampling_ratio=2)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
