@@ -26,6 +26,38 @@ from .device import Place, current_place
 from . import autograd
 
 # Set by paddle_tpu.jit.trace while a to_static capture is active.
+
+# Trace-time shape-read taint hook — installed by paddle_tpu.static while a
+# Program is being recorded.  Signature: fn(tensor, [int]) -> [int]; returns
+# SymbolicDim-wrapped entries for dims derived from a None-declared feed so
+# closure-baked attrs can be detected (static/program.py).
+_shape_taint_hook = None
+
+
+class SymbolicDim(int):
+    """An int read from a feed-derived tensor's shape during static
+    recording.  Ops that bake such a value into a closure attribute are
+    flagged; Executor.run raises if a later feed contradicts the baked
+    size (reference programs re-infer shapes at run time instead)."""
+
+    __slots__ = ()
+
+    # arithmetic keeps the taint so `x.shape[0] * n` style attrs are caught
+    def __add__(self, o): return SymbolicDim(int.__add__(self, int(o)))
+    def __radd__(self, o): return SymbolicDim(int(o) + int(self))
+    def __sub__(self, o): return SymbolicDim(int.__sub__(self, int(o)))
+    def __rsub__(self, o): return SymbolicDim(int(o) - int(self))
+    def __mul__(self, o): return SymbolicDim(int.__mul__(self, int(o)))
+    def __rmul__(self, o): return SymbolicDim(int(o) * int(self))
+    def __floordiv__(self, o): return SymbolicDim(int(self) // int(o))
+    def __rfloordiv__(self, o): return SymbolicDim(int(o) // int(self))
+    def __mod__(self, o): return SymbolicDim(int(self) % int(o))
+    def __neg__(self): return SymbolicDim(-int(self))
+
+    def __repr__(self):
+        return f"SymbolicDim({int(self)})"
+
+
 _trace_hook = None
 
 
@@ -134,7 +166,9 @@ class Tensor:
 
     @property
     def shape(self) -> List[int]:
-        return list(self._value().shape)
+        s = list(self._value().shape)
+        h = _shape_taint_hook
+        return h(self, s) if h is not None else s
 
     @property
     def ndim(self) -> int:

@@ -5,8 +5,14 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
-from ..core.tensor import Tensor
+from ..core.tensor import SymbolicDim, Tensor
 from ..core import dtype as dtype_mod
+
+
+def _as_int(x):
+    """int() that keeps the static-recording shape taint (SymbolicDim) so
+    attrs computed from feed-derived dims stay detectable."""
+    return x if isinstance(x, SymbolicDim) else int(x)
 
 
 def unwrap(x):
@@ -34,7 +40,7 @@ def paddle_reshape_shape(orig_shape, shape):
     """Paddle reshape semantics: 0 keeps the original dim, -1 infers."""
     out = []
     for i, s in enumerate(shape):
-        s = int(s)
+        s = _as_int(s)
         if s == 0:
             out.append(orig_shape[i])
         else:
@@ -51,6 +57,6 @@ def as_int_list(v):
             if isinstance(x, Tensor):
                 res.append(int(x.item()))
             else:
-                res.append(int(x))
+                res.append(_as_int(x))
         return res
-    return [int(v)]
+    return [_as_int(v)]

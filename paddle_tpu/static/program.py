@@ -14,10 +14,12 @@ executor reading scope variables.  `Executor.run` replays the SSA DAG
 under `jax.jit` with feeds substituted: the InterpreterCore's job done
 by the compiler (SURVEY.md §7).
 
-Known limitation (documented contract): ops whose ATTRIBUTES are derived
-from input shapes at trace time (e.g. reshape/flatten computing a target
-from a `None` batch dim recorded as 1) bake those attrs; declare real
-sizes in `static.data` when such ops depend on them.
+Shape-derived attributes are GUARDED: dims read from feed-derived
+tensors during recording come back as SymbolicDim ints; any op that bakes
+one into its attrs/primal closure (reshape/flatten computing a target from
+a `None` batch dim recorded as 1) is flagged, and Executor.run raises if a
+feed contradicts the baked size instead of replaying silently-wrong
+numbers (reference programs re-infer shapes at run time).
 """
 from __future__ import annotations
 
@@ -31,7 +33,25 @@ import jax.numpy as jnp
 
 from ..core import dispatch as dispatch_mod
 from ..core import dtype as dtype_mod
-from ..core.tensor import Tensor
+from ..core import tensor as tensor_mod
+from ..core.tensor import SymbolicDim, Tensor
+
+
+def _contains_symbolic(obj, _depth=0):
+    """True if a SymbolicDim is reachable in obj (attrs, lists, dicts, or a
+    primal's closure cells — reshape-style ops bake computed targets there)."""
+    if _depth > 6:
+        return False
+    if isinstance(obj, SymbolicDim):
+        return True
+    if isinstance(obj, (list, tuple, set)):
+        return any(_contains_symbolic(v, _depth + 1) for v in obj)
+    if isinstance(obj, dict):
+        return any(_contains_symbolic(v, _depth + 1) for v in obj.values())
+    if callable(obj) and getattr(obj, "__closure__", None):
+        return any(_contains_symbolic(c.cell_contents, _depth + 1)
+                   for c in obj.__closure__)
+    return False
 
 
 class _RawOp:
@@ -74,6 +94,21 @@ class Program:
         self._declared_shapes: Dict[str, list] = {}
         self._cache = {}
         self._n_post_run = 0   # ops dispatched (and dropped) after finalize
+        # shape-taint bookkeeping: feeds declared with None/-1 dims and the
+        # tensors derived from them; ops that baked a SymbolicDim into
+        # their attrs/closure are listed with reasons for the run check
+        self._sym_feeds: Dict[str, list] = {}    # name -> [axis, ...]
+        # id -> weakref (identity membership; Tensor.__eq__ is elementwise
+        # so hash-based sets cannot hold tensors)
+        self._descendants: Dict[int, object] = {}
+        self._baked_shape_ops: List[str] = []
+
+    def _is_descendant(self, t) -> bool:
+        r = self._descendants.get(id(t))
+        return r is not None and r() is t
+
+    def _add_descendant(self, t):
+        self._descendants[id(t)] = weakref.ref(t)
 
     # -- recording ------------------------------------------------------
     def _record(self, name, primal, tensor_args, kwargs, outs):
@@ -88,6 +123,15 @@ class Program:
             # this program still errors by identity validation.
             self._n_post_run += 1
             return
+        if self._sym_feeds:
+            tainted = any(isinstance(a, Tensor) and self._is_descendant(a)
+                          for a in tensor_args)
+            if tainted:
+                for o in outs:
+                    if isinstance(o, Tensor):
+                        self._add_descendant(o)
+            if _contains_symbolic((primal, kwargs)):
+                self._baked_shape_ops.append(name)
         self._raw.append(_RawOp(name, primal, list(tensor_args),
                                 dict(kwargs), list(outs)))
         self._cache.clear()
@@ -267,12 +311,32 @@ def _record_hook(name, primal, tensor_args, kwargs, outs):
     _current_main._record(name, primal, tensor_args, kwargs, outs)
 
 
+def _taint_shape(t, dims):
+    """Shape reads during recording: wrap feed-derived dims in SymbolicDim
+    so attrs computed from them are detectable (the documented reshape
+    footgun).  Placeholders taint their declared None axes; derived
+    tensors taint dims that carry a None-axis dummy size (1)."""
+    prog = _current_main
+    if not prog._sym_feeds:
+        return dims
+    name = getattr(t, "name", "")
+    axes = prog._sym_feeds.get(name)
+    if axes is not None and t is prog._feed_vars.get(name):
+        return [SymbolicDim(d) if i in axes else d
+                for i, d in enumerate(dims)]
+    if prog._is_descendant(t):
+        return [SymbolicDim(d) if d == 1 else d for d in dims]
+    return dims
+
+
 def _install_hook():
     dispatch_mod._static_record_hook = _record_hook
+    tensor_mod._shape_taint_hook = _taint_shape
 
 
 def _remove_hook():
     dispatch_mod._static_record_hook = None
+    tensor_mod._shape_taint_hook = None
 
 
 def _sync_hook():
@@ -289,10 +353,11 @@ def data(name, shape, dtype=None, lod_level=0):
     """Declare a feed placeholder (reference static.data): a zero tensor
     registered with the current Program; Executor.run feeds override it.
 
-    `None`/-1 dims are recorded at size 1 and may be fed at any size —
-    but ops whose attributes derive from input shapes at build time
-    (reshape/flatten with computed targets) bake the build-time shape;
-    declare real sizes when using those.
+    `None`/-1 dims are recorded at size 1 and may be fed at any size.
+    Ops whose attributes derive from such a dim at build time
+    (reshape/flatten with computed targets) bake the build-time dummy —
+    detected via SymbolicDim taint; Executor.run raises on a
+    contradicting feed rather than replaying wrong numbers.
     """
     dt = dtype_mod.convert_dtype(dtype) if dtype else \
         dtype_mod.get_default_dtype()
@@ -301,6 +366,11 @@ def data(name, shape, dtype=None, lod_level=0):
     t.name = name
     # declared shape kept on the Program (None dims export symbolically)
     _current_main._register_data(name, t, declared_shape=shape)
+    sym_axes = [i for i, s_ in enumerate(shape)
+                if s_ is None or int(s_) < 0]
+    if sym_axes:
+        _current_main._sym_feeds[name] = sym_axes
+        _current_main._add_descendant(t)
     return t
 
 
@@ -378,6 +448,20 @@ class Executor:
                                "static.data in this program")
             want = prog._feed_vars[k]._data
             arr = jnp.asarray(np.asarray(v)).astype(want.dtype)
+            if prog._baked_shape_ops:
+                axes = prog._sym_feeds.get(k, ())
+                for ax in axes:
+                    if ax < arr.ndim and arr.shape[ax] != want.shape[ax]:
+                        ops_ = sorted(set(prog._baked_shape_ops))
+                        raise RuntimeError(
+                            f"feed {k!r} has size {arr.shape[ax]} at its "
+                            f"None-declared axis {ax}, but ops "
+                            f"{ops_} baked an attribute computed from the "
+                            f"build-time dummy size {want.shape[ax]} — the "
+                            "replay would be silently wrong.  Declare the "
+                            "real size in static.data, or avoid computing "
+                            "shape attributes from a None dim (reference "
+                            "programs re-infer these at run time)")
             feed_arrays[k] = arr
         prog._finalize()
         fetch_locs = tuple(prog._locate(t) for t in fetch_list)

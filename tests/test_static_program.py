@@ -281,3 +281,50 @@ class TestReviewRegressions:
         got, = exe.run(prog2, feed={'x': big}, fetch_list=fetches)
         np.testing.assert_allclose(got, big @ np.asarray(w.numpy()),
                                    rtol=1e-4)
+
+
+class TestShapeAttrBakeDetection:
+    """The documented reshape footgun (static/program.py header) must now
+    raise at Executor.run instead of producing silently-wrong numbers
+    (VERDICT r3 #9)."""
+
+    def test_baked_none_dim_attr_raises_on_other_batch(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 4], 'float32')
+            # shape-derived attr: batch recorded as dummy 1 gets baked
+            y = x.reshape([x.shape[0], 2, 2]).sum()
+        exe = static.Executor()
+        # feeding the dummy batch is consistent -> fine
+        out, = exe.run(main, feed={'x': np.ones((1, 4), np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+        with pytest.raises(RuntimeError, match="baked"):
+            exe.run(main, feed={'x': np.ones((8, 4), np.float32)},
+                    fetch_list=[y])
+
+    def test_dynamic_batch_without_shape_attrs_still_works(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 3], 'float32')
+            y = (x * 2.0).sum(axis=1)
+        exe = static.Executor()
+        for b in (1, 4, 9):
+            out, = exe.run(main, feed={'x': np.ones((b, 3), np.float32)},
+                           fetch_list=[y])
+            assert np.asarray(out).shape == (b,)
+
+    def test_weight_shape_attrs_not_flagged(self, static_mode):
+        # attrs derived from a CONSTANT tensor's shape are valid bakes and
+        # must not block dynamic feeds
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 6], 'float32')
+            w = paddle.to_tensor(np.ones((6, 2), np.float32))
+            h = x.matmul(w)          # feed-descendant
+            wr = w.reshape([w.shape[0] * w.shape[1]])  # const-shape attr
+            y = h.sum() + wr.sum()
+        exe = static.Executor()
+        out, = exe.run(main, feed={'x': np.ones((5, 6), np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(np.asarray(out), 5 * 6 * 1 * 2 + 12)
