@@ -36,26 +36,35 @@ _shape_taint_hook = None
 
 class SymbolicDim(int):
     """An int read from a feed-derived tensor's shape during static
-    recording.  Ops that bake such a value into a closure attribute are
-    flagged; Executor.run raises if a later feed contradicts the baked
-    size (reference programs re-infer shapes at run time instead)."""
+    recording, carrying WHICH None-declared feeds it may derive from.
+    Ops that bake such a value into a closure attribute are flagged;
+    Executor.run raises only when one of THOSE feeds is fed a
+    contradicting size (reference programs re-infer shapes at run time
+    instead)."""
 
-    __slots__ = ()
+    def __new__(cls, v, feeds=frozenset()):
+        self = super().__new__(cls, v)
+        self.feeds = frozenset(feeds)
+        return self
+
+    def _mix(self, v, o):
+        of = o.feeds if isinstance(o, SymbolicDim) else frozenset()
+        return SymbolicDim(v, self.feeds | of)
 
     # arithmetic keeps the taint so `x.shape[0] * n` style attrs are caught
-    def __add__(self, o): return SymbolicDim(int.__add__(self, int(o)))
-    def __radd__(self, o): return SymbolicDim(int(o) + int(self))
-    def __sub__(self, o): return SymbolicDim(int.__sub__(self, int(o)))
-    def __rsub__(self, o): return SymbolicDim(int(o) - int(self))
-    def __mul__(self, o): return SymbolicDim(int.__mul__(self, int(o)))
-    def __rmul__(self, o): return SymbolicDim(int(o) * int(self))
-    def __floordiv__(self, o): return SymbolicDim(int(self) // int(o))
-    def __rfloordiv__(self, o): return SymbolicDim(int(o) // int(self))
-    def __mod__(self, o): return SymbolicDim(int(self) % int(o))
-    def __neg__(self): return SymbolicDim(-int(self))
+    def __add__(self, o): return self._mix(int(self) + int(o), o)
+    def __radd__(self, o): return self._mix(int(o) + int(self), o)
+    def __sub__(self, o): return self._mix(int(self) - int(o), o)
+    def __rsub__(self, o): return self._mix(int(o) - int(self), o)
+    def __mul__(self, o): return self._mix(int(self) * int(o), o)
+    def __rmul__(self, o): return self._mix(int(o) * int(self), o)
+    def __floordiv__(self, o): return self._mix(int(self) // int(o), o)
+    def __rfloordiv__(self, o): return self._mix(int(o) // int(self), o)
+    def __mod__(self, o): return self._mix(int(self) % int(o), o)
+    def __neg__(self): return SymbolicDim(-int(self), self.feeds)
 
     def __repr__(self):
-        return f"SymbolicDim({int(self)})"
+        return f"SymbolicDim({int(self)}, feeds={sorted(self.feeds)})"
 
 
 _trace_hook = None

@@ -44,6 +44,15 @@ class StaticFunction:
 
     def __init__(self, fn, input_spec=None, build_strategy=None,
                  backend=None, donate=True):
+        import os
+
+        if not os.environ.get("PADDLE_TPU_NO_AST_CONVERT"):
+            # reference program_translator.py:239 — rewrite python
+            # if/while/for over tensors into cond/while_loop calls (no-op
+            # on functions without convertible control flow)
+            from .dy2static import convert_function
+
+            fn = convert_function(fn)
         self._fn = fn
         self._input_spec = input_spec
         self._programs: dict = {}
@@ -54,6 +63,14 @@ class StaticFunction:
     @property
     def program_cache(self):
         return self._programs
+
+    def last_program(self):
+        """The most recently built CompiledProgram (for
+        compiled_stats introspection)."""
+        if not self._programs:
+            raise RuntimeError("no program compiled yet — call the "
+                               "function once first")
+        return next(reversed(self._programs.values()))
 
     def _extra_key(self, args):
         """Mode bits that change the traced python path."""

@@ -1,0 +1,548 @@
+"""Dygraph-to-static AST conversion of native python control flow.
+
+Reference parity: dygraph_to_static/program_translator.py:239 (the
+``@to_static`` source rewrite), ifelse_transformer.py, loop_transformer.py.
+The reference rewrites ``if``/``while``/``for`` over Variables into
+ConditionalBlock/While ops; here they are rewritten into calls to the
+runtime converters below, which fall back to plain python when the
+predicate is CONCRETE (the reference's dygraph fallback) and lower to
+``static.cond`` / ``static.while_loop`` (→ ``lax.cond`` /
+``lax.while_loop``) when it is a traced Tensor.
+
+Mechanics (simplified versus the reference's multi-pass transformer
+pipeline, but with the same variable-capture contract):
+
+- each branch/loop body becomes a local function whose parameters are the
+  names the body READS and whose returns are the names it ASSIGNS;
+- possibly-unbound names are captured through ``ld`` (a try/except
+  closure read) and flow as ``UndefinedVar`` sentinels that raise a clear
+  message on first real use;
+- statements containing ``return``/``break``/``continue``/``global``/
+  ``nonlocal``/``del`` at conversion scope are left untouched: python
+  semantics are preserved for concrete predicates, and a traced-tensor
+  predicate keeps today's explicit error.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import List, Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_function", "convert_ifelse", "convert_while",
+           "convert_range_loop", "ld", "UndefinedVar"]
+
+
+# ---------------------------------------------------------------------------
+# runtime
+# ---------------------------------------------------------------------------
+
+class UndefinedVar:
+    """A name that was unbound when captured.  Any real use raises with
+    the variable name (reference: dygraph_to_static UndefinedVar)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name=""):
+        self.name = name
+
+    def _raise(self):
+        raise NameError(
+            f"variable '{self.name}' is not defined on every path through "
+            "converted control flow (assigned in only one branch, or read "
+            "before the loop ever ran)")
+
+    def __bool__(self):
+        self._raise()
+
+    def __array__(self, *a, **k):
+        self._raise()
+
+    def __getattr__(self, item):
+        if item == "name":
+            raise AttributeError(item)
+        self._raise()
+
+    def __call__(self, *a, **k):
+        self._raise()
+
+    # implicit dunder lookup bypasses __getattr__ — name the common ones
+    def _binop(self, *a, **k):
+        self._raise()
+
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _binop
+    __truediv__ = __rtruediv__ = __matmul__ = __rmatmul__ = _binop
+    __lt__ = __le__ = __gt__ = __ge__ = __iter__ = __len__ = _binop
+    __getitem__ = __neg__ = __abs__ = __float__ = __int__ = _binop
+
+    def __repr__(self):
+        return f"UndefinedVar({self.name!r})"
+
+
+def ld(thunk, name=""):
+    """Read a possibly-unbound outer variable."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UndefinedVar(name)
+
+
+def _is_traced(v) -> bool:
+    if isinstance(v, Tensor):
+        v = v._value()
+    return isinstance(v, jax.core.Tracer)
+
+
+def _layer_params(operands):
+    """Parameters/buffers of any Layer operand (incl. `self`), listed so
+    static.cond's tape vjp sees them — closure captures bypass the tape."""
+    from ..nn.layer_base import Layer
+
+    seen, ps = set(), []
+    for o in operands:
+        if isinstance(o, Layer):
+            for t in (list(o.parameters())
+                      + [b for _, b in o.named_buffers()]):
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    ps.append(t)
+    return ps
+
+
+def convert_ifelse(pred, true_fn, false_fn, operands=()):
+    """``if pred: ... else: ...`` with assigned-name outputs."""
+    from ..static.nn import cond as static_cond
+
+    p = pred._value() if isinstance(pred, Tensor) else pred
+    if isinstance(p, jax.core.Tracer):
+        out = static_cond(pred, true_fn, false_fn, operands,
+                          params=_layer_params(operands))
+        return out if isinstance(out, tuple) else (out,)
+    taken = true_fn if bool(
+        pred.item() if isinstance(pred, Tensor) else pred) else false_fn
+    out = taken(*operands)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _promote_loop_vars(vars_):
+    """Python scalars in a TRACED loop must become Tensors, or their
+    body updates would be silently dropped by lax.while_loop."""
+    out = []
+    for v in vars_:
+        if isinstance(v, (bool, int, float)) and not isinstance(v, Tensor):
+            out.append(Tensor._wrap(jnp.asarray(v)))
+        else:
+            out.append(v)
+    return out
+
+
+def convert_while(cond_fn, body_fn, init_vars):
+    """``while cond: body`` over the body's assigned names."""
+    from ..static.nn import while_loop
+
+    init_vars = list(init_vars)
+    traced = any(_is_traced(v) for v in init_vars) or \
+        _is_traced(cond_fn(*init_vars))
+    if traced:
+        init_vars = _promote_loop_vars(init_vars)
+    out = while_loop(cond_fn, body_fn, init_vars)
+    return tuple(out)
+
+
+def convert_range_loop(start, stop, step, body_fn, init_vars):
+    """``for i in range(start, stop, step): body`` — body_fn(i, *vars) ->
+    vars.  Concrete bounds run the plain python loop (still unrolls under
+    an outer trace, matching previous behavior); traced bounds lower to a
+    while_loop with the index as a carried Tensor."""
+    from ..static.nn import while_loop
+
+    bounds = [start, stop, step]
+    if not any(_is_traced(b) for b in bounds):
+        vars_ = tuple(init_vars)
+        s0 = int(start.item() if isinstance(start, Tensor) else start)
+        s1 = int(stop.item() if isinstance(stop, Tensor) else stop)
+        st = int(step.item() if isinstance(step, Tensor) else step)
+        for i in range(s0, s1, st):
+            vars_ = body_fn(i, *vars_)
+        return tuple(vars_)
+
+    init = _promote_loop_vars([start] + list(init_vars))
+    step_c = step if isinstance(step, Tensor) else Tensor._wrap(
+        jnp.asarray(step))
+    stop_c = stop if isinstance(stop, Tensor) else Tensor._wrap(
+        jnp.asarray(stop))
+
+    def _cond(i, *vars_):
+        up = (step_c._value() if isinstance(step_c, Tensor) else step_c) > 0
+        iv = i._value() if isinstance(i, Tensor) else i
+        sv = stop_c._value()
+        return Tensor._wrap(jnp.where(up, iv < sv, iv > sv))
+
+    def _body(i, *vars_):
+        new = body_fn(i, *vars_)
+        new = new if isinstance(new, tuple) else (new,)
+        nxt = Tensor._wrap(
+            (i._value() if isinstance(i, Tensor) else i)
+            + (step_c._value() if isinstance(step_c, Tensor) else step_c))
+        return (nxt,) + tuple(new)
+
+    out = while_loop(_cond, _body, init)
+    return tuple(out[1:])
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+# ---------------------------------------------------------------------------
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+_BAIL_NODES = (ast.Return, ast.Break, ast.Continue, ast.Global,
+               ast.Nonlocal, ast.Delete, ast.Yield, ast.YieldFrom,
+               ast.Await)
+
+
+def _walk_scope(node):
+    """ast.walk that does not descend into nested function/class defs
+    (their bodies are separate scopes), but does cover lambdas and
+    comprehensions (their reads matter for capture)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_BARRIERS):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _walk_stmt(s):
+    """The statement itself plus its same-scope subtree (if the statement
+    IS a def, its body is a separate scope and is not entered)."""
+    yield s
+    if not isinstance(s, _SCOPE_BARRIERS):
+        yield from _walk_scope(s)
+
+
+def _nonname_store(n) -> bool:
+    """Assignments into attributes/subscripts are object mutations whose
+    effects would silently vanish inside a traced branch — bail."""
+    tgts = []
+    if isinstance(n, ast.Assign):
+        tgts = n.targets
+    elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+        tgts = [n.target]
+
+    def bad(t):
+        if isinstance(t, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return not isinstance(t, ast.Starred) or bad(t.value)
+        if isinstance(t, (ast.Tuple, ast.List)):
+            return any(bad(e) for e in t.elts)
+        return False
+
+    return any(bad(t) for t in tgts)
+
+
+def _has_bail(stmts) -> bool:
+    for s in stmts:
+        for n in _walk_stmt(s):
+            if _nonname_store(n):
+                return True
+            if isinstance(n, _BAIL_NODES):
+                # break/continue inside a NESTED loop are that loop's
+                # business, not ours
+                if isinstance(n, (ast.Break, ast.Continue)):
+                    if _inside_nested_loop(s, n):
+                        continue
+                return True
+    return False
+
+
+def _inside_nested_loop(root_stmt, node) -> bool:
+    """True if `node` sits under a For/While that is itself inside
+    root_stmt (so the break/continue does not escape the converted
+    region)."""
+    # collect all loop subtrees strictly inside root_stmt
+    for n in _walk_scope(root_stmt):
+        if isinstance(n, (ast.For, ast.While)):
+            for m in [n] + list(_walk_scope(n)):
+                if m is node:
+                    return True
+    return False
+
+
+def _assigned_names(stmts) -> Set[str]:
+    names: Set[str] = set()
+
+    def targets_of(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets_of(e)
+        elif isinstance(t, ast.Starred):
+            targets_of(t.value)
+
+    for s in stmts:
+        for n in _walk_stmt(s):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    targets_of(t)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets_of(n.target)
+            elif isinstance(n, ast.For):
+                targets_of(n.target)
+            elif isinstance(n, ast.withitem) and n.optional_vars:
+                targets_of(n.optional_vars)
+            elif isinstance(n, ast.NamedExpr):
+                targets_of(n.target)
+            elif isinstance(n, _SCOPE_BARRIERS):
+                names.add(n.name)
+    # generated helpers are locals of their own region, and function/class
+    # defs cannot cross a lax.cond boundary as outputs
+    return {n for n in names if not n.startswith("__jst_")}
+
+
+def _loaded_names(stmts) -> Set[str]:
+    loads: Set[str] = set()
+    for s in stmts:
+        for n in _walk_stmt(s):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                loads.add(n.id)
+    return {n for n in loads if not n.startswith("__jst_")}
+
+
+# ---------------------------------------------------------------------------
+# transformer
+# ---------------------------------------------------------------------------
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=_name("_jst"), attr=fn_name, ctx=ast.Load())
+
+
+def _ld_expr(var: str):
+    """_jst.ld(lambda: var, 'var')"""
+    lam = ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=_name(var))
+    return ast.Call(func=_jst_attr("ld"),
+                    args=[lam, ast.Constant(var)], keywords=[])
+
+
+def _branch_funcdef(fname: str, params: List[str], body: List[ast.stmt],
+                    out_names: List[str]) -> ast.FunctionDef:
+    ret = ast.Return(value=ast.Tuple(
+        elts=[_ld_expr(n) for n in out_names], ctx=ast.Load()))
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=p) for p in params],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=(body or [ast.Pass()]) + [ret],
+        decorator_list=[])
+
+
+def _unpack_assign(out_names: List[str], value: ast.expr) -> ast.stmt:
+    tgt = ast.Tuple(elts=[_name(n, ast.Store()) for n in out_names],
+                    ctx=ast.Store())
+    return ast.Assign(targets=[tgt], value=value)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.changed = False
+        self._uid = 0
+
+    def _next(self, kind):
+        self._uid += 1
+        return f"__jst_{kind}_{self._uid}"
+
+    # do not descend into nested defs — they are separate conversions
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)   # innermost first
+        if _has_bail(node.body) or _has_bail(node.orelse):
+            return node
+        assigned = sorted(_assigned_names(node.body)
+                          | _assigned_names(node.orelse))
+        if not assigned:
+            # nothing flows out: conversion could only lose side-effect
+            # semantics under tracing — keep the python if
+            return node
+        reads = sorted((_loaded_names(node.body)
+                        | _loaded_names(node.orelse)
+                        | _loaded_names([ast.Expr(node.test)])) - {"_jst"})
+        tname = self._next("true")
+        fname = self._next("false")
+        true_def = _branch_funcdef(tname, reads, node.body, assigned)
+        false_def = _branch_funcdef(fname, reads, node.orelse, assigned)
+        call = ast.Call(
+            func=_jst_attr("convert_ifelse"),
+            args=[node.test, _name(tname), _name(fname),
+                  ast.Tuple(elts=[_ld_expr(r) for r in reads],
+                            ctx=ast.Load())],
+            keywords=[])
+        self.changed = True
+        return [true_def, false_def, _unpack_assign(assigned, call)]
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _has_bail(node.body):
+            return node
+        assigned = sorted(_assigned_names(node.body))
+        if not assigned:
+            return node
+        reads = sorted((_loaded_names(node.body)
+                        | _loaded_names([ast.Expr(node.test)]))
+                       - set(assigned) - {"_jst"})
+        cname = self._next("cond")
+        bname = self._next("body")
+        params = assigned  # loop-carried; reads stay free (closures)
+        cond_def = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=p) for p in params],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[])
+        body_def = _branch_funcdef(bname, params, node.body, assigned)
+        call = ast.Call(
+            func=_jst_attr("convert_while"),
+            args=[_name(cname), _name(bname),
+                  ast.Tuple(elts=[_ld_expr(n) for n in assigned],
+                            ctx=ast.Load())],
+            keywords=[])
+        self.changed = True
+        return [cond_def, body_def, _unpack_assign(assigned, call)]
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        # only `for <name> in range(...)` without else
+        if (node.orelse or _has_bail(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not 1 <= len(node.iter.args) <= 3
+                or any(isinstance(a, ast.Starred)
+                       for a in node.iter.args)):
+            return node
+        assigned = sorted(_assigned_names(node.body) - {node.target.id})
+        if not assigned:
+            return node
+        ra = node.iter.args
+        if len(ra) == 1:
+            start, stop, step = ast.Constant(0), ra[0], ast.Constant(1)
+        elif len(ra) == 2:
+            start, stop, step = ra[0], ra[1], ast.Constant(1)
+        else:
+            start, stop, step = ra
+        bname = self._next("forbody")
+        body_def = _branch_funcdef(
+            bname, [node.target.id] + assigned, node.body, assigned)
+        call = ast.Call(
+            func=_jst_attr("convert_range_loop"),
+            args=[start, stop, step, _name(bname),
+                  ast.Tuple(elts=[_ld_expr(n) for n in assigned],
+                            ctx=ast.Load())],
+            keywords=[])
+        self.changed = True
+        return [body_def, _unpack_assign(assigned, call)]
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+class _LiveGlobals(dict):
+    """exec/function globals that fall through to the original module's
+    dict on miss — rebindings of module globals stay visible to the
+    converted function.  (Closure cell VALUES are still snapshotted at
+    conversion time: rebinding an enclosing local after decoration is not
+    reflected — same as the reference's converted-function cache.)"""
+
+    def __init__(self, base, extra):
+        super().__init__(extra)
+        self._base = base
+
+    def __missing__(self, k):
+        return self._base[k]
+
+
+_CONVERTED_MARK = "__jst_converted__"
+
+
+def convert_function(fn):
+    """AST-convert python control flow in ``fn``; returns ``fn`` itself
+    when nothing needs converting or the source is unavailable."""
+    bound_self = None
+    if inspect.ismethod(fn):
+        bound_self = fn.__self__
+        fn = fn.__func__
+    if getattr(fn, _CONVERTED_MARK, False):
+        return fn if bound_self is None else fn.__get__(bound_self)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn if bound_self is None else fn.__get__(bound_self)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn if bound_self is None else fn.__get__(bound_self)
+    # only the to_static decorator itself may be stripped; any other
+    # decorator would be silently dropped by recompilation — bail
+    for dec in fdef.decorator_list:
+        if "to_static" not in ast.unparse(dec):
+            setattr(fn, _CONVERTED_MARK, True)
+            return fn if bound_self is None else fn.__get__(bound_self)
+    fdef.decorator_list = []
+    tr = _ControlFlowTransformer()
+    fdef.body = [x for stmt in fdef.body
+                 for x in _as_list(tr.visit(stmt))]
+    if not tr.changed:
+        setattr(fn, _CONVERTED_MARK, True)
+        return fn if bound_self is None else fn.__get__(bound_self)
+    ast.fix_missing_locations(tree)
+    from . import dy2static as _jst_mod
+
+    # LIVE view of the module globals: a snapshot copy would silently pin
+    # every later-rebound module global (config flags, the function's own
+    # name for recursion) to its value at decoration time
+    extras = {"_jst": _jst_mod}
+    if fn.__closure__:
+        for nm, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                extras[nm] = cell.cell_contents
+            except ValueError:   # empty cell
+                pass
+    ns = _LiveGlobals(fn.__globals__, extras)
+    code = compile(tree, filename=f"<dy2static {fn.__code__.co_filename}>",
+                   mode="exec")
+    exec(code, ns)
+    new_fn = ns[fdef.name]
+    functools.update_wrapper(new_fn, fn)
+    setattr(new_fn, _CONVERTED_MARK, True)
+    return new_fn if bound_self is None else new_fn.__get__(bound_self)
+
+
+def _as_list(v):
+    return v if isinstance(v, list) else [v]

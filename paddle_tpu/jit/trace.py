@@ -370,6 +370,31 @@ class CompiledProgram:
         sk = [state_arrays[i] for i in self._keep_idx]
         return sd, sk
 
+    def compiled_stats(self):
+        """Compile-time introspection of the current program signature:
+        XLA memory analysis + optimized HLO text (shares jax's executable
+        cache with normal calls — cheap after the first run).  Powers the
+        multichip gate's per-config stats (collective bytes, peak HBM)."""
+        state_arrays = [k.current() for k in self.state_keys]
+        sd, sk = self._split_state(state_arrays)
+        run = self.jitted_donate if self.donate else self.jitted
+        lowered = run.lower(self._last_arg_arrays, sd, sk)
+        compiled = lowered.compile()
+        out = {"hlo": compiled.as_text()}
+        try:
+            ma = compiled.memory_analysis()
+            out["argument_bytes"] = int(ma.argument_size_in_bytes)
+            out["output_bytes"] = int(ma.output_size_in_bytes)
+            out["temp_bytes"] = int(ma.temp_size_in_bytes)
+            out["alias_bytes"] = int(ma.alias_size_in_bytes)
+            out["peak_bytes"] = int(ma.argument_size_in_bytes
+                                    + ma.output_size_in_bytes
+                                    + ma.temp_size_in_bytes
+                                    - ma.alias_size_in_bytes)
+        except Exception:
+            pass
+        return out
+
     def _writeback(self, write_arrays):
         for k, none_at_build, arr in zip(
                 self.write_keys, self.write_none_mask, write_arrays):
@@ -380,6 +405,7 @@ class CompiledProgram:
 
     def __call__(self, arg_tensors):
         arg_arrays = [t._value() for t in arg_tensors]
+        self._last_arg_arrays = arg_arrays
         state_arrays = [k.current() for k in self.state_keys]
 
         outer_diff = (

@@ -37,21 +37,25 @@ from ..core import tensor as tensor_mod
 from ..core.tensor import SymbolicDim, Tensor
 
 
-def _contains_symbolic(obj, _depth=0):
-    """True if a SymbolicDim is reachable in obj (attrs, lists, dicts, or a
-    primal's closure cells — reshape-style ops bake computed targets there)."""
+def _symbolic_feeds(obj, _depth=0):
+    """Union of feed names of every SymbolicDim reachable in obj (attrs,
+    lists, dicts, or a primal's closure cells — reshape-style ops bake
+    computed targets there)."""
     if _depth > 6:
-        return False
+        return frozenset()
     if isinstance(obj, SymbolicDim):
-        return True
+        return obj.feeds or frozenset(["<unknown>"])
+    out = frozenset()
     if isinstance(obj, (list, tuple, set)):
-        return any(_contains_symbolic(v, _depth + 1) for v in obj)
-    if isinstance(obj, dict):
-        return any(_contains_symbolic(v, _depth + 1) for v in obj.values())
-    if callable(obj) and getattr(obj, "__closure__", None):
-        return any(_contains_symbolic(c.cell_contents, _depth + 1)
-                   for c in obj.__closure__)
-    return False
+        for v in obj:
+            out |= _symbolic_feeds(v, _depth + 1)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            out |= _symbolic_feeds(v, _depth + 1)
+    elif callable(obj) and getattr(obj, "__closure__", None):
+        for c in obj.__closure__:
+            out |= _symbolic_feeds(c.cell_contents, _depth + 1)
+    return out
 
 
 class _RawOp:
@@ -98,6 +102,7 @@ class Program:
         # tensors derived from them; ops that baked a SymbolicDim into
         # their attrs/closure are listed with reasons for the run check
         self._sym_feeds: Dict[str, list] = {}    # name -> [axis, ...]
+        self._sym_dummy: Dict[int, list] = {}    # dummy size -> [feed, ...]
         # id -> weakref (identity membership; Tensor.__eq__ is elementwise
         # so hash-based sets cannot hold tensors)
         self._descendants: Dict[int, object] = {}
@@ -130,8 +135,9 @@ class Program:
                 for o in outs:
                     if isinstance(o, Tensor):
                         self._add_descendant(o)
-            if _contains_symbolic((primal, kwargs)):
-                self._baked_shape_ops.append(name)
+            feeds = _symbolic_feeds((primal, kwargs))
+            if feeds:
+                self._baked_shape_ops.append((name, feeds))
         self._raw.append(_RawOp(name, primal, list(tensor_args),
                                 dict(kwargs), list(outs)))
         self._cache.clear()
@@ -315,17 +321,20 @@ def _taint_shape(t, dims):
     """Shape reads during recording: wrap feed-derived dims in SymbolicDim
     so attrs computed from them are detectable (the documented reshape
     footgun).  Placeholders taint their declared None axes; derived
-    tensors taint dims that carry a None-axis dummy size (1)."""
+    tensors taint dims carrying a feed's distinctive dummy size — the
+    taint names WHICH feeds it derives from, so the run-time check only
+    fires for contradicting feeds."""
     prog = _current_main
     if not prog._sym_feeds:
         return dims
     name = getattr(t, "name", "")
     axes = prog._sym_feeds.get(name)
     if axes is not None and t is prog._feed_vars.get(name):
-        return [SymbolicDim(d) if i in axes else d
+        return [SymbolicDim(d, {name}) if i in axes else d
                 for i, d in enumerate(dims)]
     if prog._is_descendant(t):
-        return [SymbolicDim(d) if d == 1 else d for d in dims]
+        return [SymbolicDim(d, prog._sym_dummy[d])
+                if d in prog._sym_dummy else d for d in dims]
     return dims
 
 
@@ -361,17 +370,41 @@ def data(name, shape, dtype=None, lod_level=0):
     """
     dt = dtype_mod.convert_dtype(dtype) if dtype else \
         dtype_mod.get_default_dtype()
-    concrete = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
+    sym_axes = [i for i, s_ in enumerate(shape)
+                if s_ is None or int(s_) < 0]
+    # None dims record at a DISTINCTIVE dummy size (not 1: size-1 dims are
+    # everywhere — keepdim axes, singleton channels — and would false-flag
+    # the shape-bake guard).  Each program cycles through odd primes so a
+    # dim VALUE identifies which feed it derived from.
+    concrete = []
+    sym_val = {}
+    for i, s_ in enumerate(shape):
+        if i in sym_axes:
+            v = _next_sym_size(_current_main)
+            sym_val[i] = v
+            concrete.append(v)
+        else:
+            concrete.append(int(s_))
     t = Tensor._wrap(jnp.zeros(concrete, dt), stop_gradient=True)
     t.name = name
     # declared shape kept on the Program (None dims export symbolically)
     _current_main._register_data(name, t, declared_shape=shape)
-    sym_axes = [i for i, s_ in enumerate(shape)
-                if s_ is None or int(s_) < 0]
     if sym_axes:
         _current_main._sym_feeds[name] = sym_axes
+        for v in sym_val.values():
+            _current_main._sym_dummy.setdefault(v, []).append(name)
         _current_main._add_descendant(t)
     return t
+
+
+_SYM_SIZE_POOL = (61, 67, 71, 73, 79, 83, 89, 97, 101, 103, 107, 109, 113)
+
+
+def _next_sym_size(prog) -> int:
+    for v in _SYM_SIZE_POOL:
+        if v not in prog._sym_dummy:
+            return v
+    return _SYM_SIZE_POOL[len(prog._sym_dummy) % len(_SYM_SIZE_POOL)]
 
 
 class Scope:
@@ -449,18 +482,20 @@ class Executor:
             want = prog._feed_vars[k]._data
             arr = jnp.asarray(np.asarray(v)).astype(want.dtype)
             if prog._baked_shape_ops:
-                axes = prog._sym_feeds.get(k, ())
+                baked_here = sorted({n for n, fs in prog._baked_shape_ops
+                                     if k in fs or "<unknown>" in fs})
+                axes = prog._sym_feeds.get(k, ()) if baked_here else ()
                 for ax in axes:
                     if ax < arr.ndim and arr.shape[ax] != want.shape[ax]:
-                        ops_ = sorted(set(prog._baked_shape_ops))
                         raise RuntimeError(
                             f"feed {k!r} has size {arr.shape[ax]} at its "
                             f"None-declared axis {ax}, but ops "
-                            f"{ops_} baked an attribute computed from the "
-                            f"build-time dummy size {want.shape[ax]} — the "
-                            "replay would be silently wrong.  Declare the "
-                            "real size in static.data, or avoid computing "
-                            "shape attributes from a None dim (reference "
+                            f"{baked_here} baked an attribute computed "
+                            f"from the build-time dummy size "
+                            f"{want.shape[ax]} — the replay would be "
+                            "silently wrong.  Declare the real size in "
+                            "static.data, or avoid computing shape "
+                            "attributes from a None dim (reference "
                             "programs re-infer these at run time)")
             feed_arrays[k] = arr
         prog._finalize()

@@ -1,0 +1,58 @@
+"""Optimized-HLO introspection: per-kind collective byte counts.
+
+Used by the multichip dry-run gate to put numbers on a sharding config
+before real hardware exists (reference analogue: the comm-volume logging
+of ProcessGroupNCCL; here the compiled program itself is the evidence).
+Parses XLA's optimized HLO text for collective ops and sums the bytes of
+their result shapes.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "collective-broadcast",
+)
+
+# `%name = TYPE[d0,d1]{layout} op-name(` — possibly a tuple `(T[..], T[..])`
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_OP_RE = re.compile(
+    r"=\s*(\([^)]*\)|[\w\[\]{},.]+)\s+(" + "|".join(_COLLECTIVES)
+    + r")(-start|-done)?\(")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(shape_text):
+        sz = _DTYPE_BYTES.get(dt)
+        if sz is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * sz
+    return total
+
+
+def collective_bytes(hlo_text: str) -> Dict[str, int]:
+    """Map collective kind -> total result bytes in the program (one
+    program = one step on one device shard; multiply by device count for
+    fleet-wide volume).  `-done` halves of async pairs are skipped so
+    start/done collectives are not double counted."""
+    out: Dict[str, int] = {}
+    for m in _OP_RE.finditer(hlo_text):
+        shape_text, kind, phase = m.group(1), m.group(2), m.group(3)
+        if phase == "-done":
+            continue
+        out[kind] = out.get(kind, 0) + _shape_bytes(shape_text)
+    out["total"] = sum(v for k, v in out.items() if k != "total")
+    return out

@@ -1,0 +1,60 @@
+"""bench.py contract: the smoke path produces the one-line JSON on CPU,
+and preflight failures emit structured JSON instead of a traceback (the
+failure class that cost round 3 its perf artifact)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_smoke_mode_emits_json_line():
+    env = dict(os.environ)
+    env["PADDLE_TPU_BENCH_SMOKE"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "gpt2_345m_train_tokens_per_sec_per_chip"
+    assert out["value"] > 0
+    assert "vs_baseline" in out
+
+
+def test_preflight_failure_is_structured():
+    """Force the probe to fail fast: preflight must print the structured
+    error JSON and exit nonzero, never a bare traceback."""
+    code = (
+        "import bench\n"
+        "bench._PROBE_SRC = 'raise SystemExit(3)'\n"
+        "bench.preflight(max_attempts=2, timeouts=(5, 5), backoffs=(0,))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "error" in out and "unreachable" in out["error"]
+    assert out["value"] == 0.0
+
+
+def test_probe_timeout_is_bounded():
+    import time
+
+    import bench
+
+    old = bench._PROBE_SRC
+    bench._PROBE_SRC = "import time; time.sleep(60)"
+    try:
+        t0 = time.monotonic()
+        ok, detail = bench._probe_backend(1.5)
+        dt = time.monotonic() - t0
+    finally:
+        bench._PROBE_SRC = old
+    assert not ok
+    assert "timed out" in detail
+    assert dt < 10

@@ -1,0 +1,283 @@
+"""AST dy2static conversion (reference: dygraph_to_static unittests —
+test_ifelse.py, test_loop.py style nets without manual cond/while_loop)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (
+    UndefinedVar, convert_function, ld)
+
+
+def _was_converted(fn):
+    g = convert_function(fn)
+    return g, g.__code__.co_filename.startswith("<dy2static")
+
+
+# ---------------------------------------------------------------------------
+# transform mechanics
+# ---------------------------------------------------------------------------
+
+def ifelse_net(x):
+    if x.sum() > 0:
+        y = x * 2
+        z = y + 1
+    else:
+        y = x - 1
+        z = y * 3
+    return z
+
+
+def test_ifelse_converted_and_correct_eager():
+    g, conv = _was_converted(ifelse_net)
+    assert conv
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(g(x).numpy(), [3.0, 5.0])
+    xn = paddle.to_tensor(np.array([-3.0, 1.0], np.float32))
+    np.testing.assert_allclose(g(xn).numpy(), [-12.0, 0.0])
+
+
+def test_ifelse_traced_single_program_both_branches():
+    g = convert_function(ifelse_net)
+    step = paddle.jit.to_static(g)
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    xn = paddle.to_tensor(np.array([-3.0, 1.0], np.float32))
+    np.testing.assert_allclose(step(x).numpy(), [3.0, 5.0])
+    # same compiled program takes the other branch at RUNTIME
+    np.testing.assert_allclose(step(xn).numpy(), [-12.0, 0.0])
+    assert len(step.program_cache) == 1
+
+
+def grad_net(x, w):
+    if x.sum() > 0:
+        y = (x * w).sum()
+    else:
+        y = (x * w * 3.0).sum()
+    return y
+
+
+def test_ifelse_traced_grads_flow():
+    g = convert_function(grad_net)
+    # w is EXTERNAL state (closed over, like a parameter): grads must flow
+    # through the converted cond back to it (args never get .grad under
+    # to_static by design)
+    w = paddle.to_tensor(np.array([2.0, 4.0], np.float32),
+                         stop_gradient=False)
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = g(x, w)
+        loss.backward()
+        return loss
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    step(x)
+    np.testing.assert_allclose(w.grad.numpy(), [1.0, 2.0])
+    w.clear_gradient()
+    xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    step(xn)
+    np.testing.assert_allclose(w.grad.numpy(), [-3.0, -6.0])
+
+
+def while_net(x, n):
+    i = 0
+    s = x * 0
+    while i < n:
+        s = s + x + i
+        i = i + 1
+    return s
+
+
+def test_while_eager_and_traced():
+    g, conv = _was_converted(while_net)
+    assert conv
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(g(x, 3).numpy(), [6.0, 9.0])
+
+    step = paddle.jit.to_static(g)
+    n = paddle.to_tensor(np.int32(3))
+    np.testing.assert_allclose(step(x, n).numpy(), [6.0, 9.0])
+    # trip count is data-dependent: same program, different n
+    n5 = paddle.to_tensor(np.int32(5))
+    np.testing.assert_allclose(step(x, n5).numpy(), [15.0, 20.0])
+    assert len(step.program_cache) == 1
+
+
+def range_net(x, n):
+    acc = x * 0
+    for i in range(n):
+        acc = acc + x * i
+    return acc
+
+
+def test_for_range_traced_tensor_bound():
+    g, conv = _was_converted(range_net)
+    assert conv
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(g(x, 3).numpy(), [3.0, 6.0])
+    step = paddle.jit.to_static(g)
+    n = paddle.to_tensor(np.int32(4))
+    np.testing.assert_allclose(step(x, n).numpy(), [6.0, 12.0])
+
+
+def nested_net(x):
+    if x.sum() > 0:
+        if x.max() > 10:
+            y = x * 100
+        else:
+            y = x * 2
+    else:
+        y = -x
+    return y
+
+
+def test_nested_if():
+    g = convert_function(nested_net)
+    step = paddle.jit.to_static(g)
+    cases = [
+        (np.array([1.0, 2.0], np.float32), [2.0, 4.0]),
+        (np.array([1.0, 20.0], np.float32), [100.0, 2000.0]),
+        (np.array([-1.0, -2.0], np.float32), [1.0, 2.0]),
+    ]
+    for arr, want in cases:
+        np.testing.assert_allclose(
+            step(paddle.to_tensor(arr)).numpy(), want)
+
+
+def one_branch_only(x):
+    y = x * 1
+    if x.sum() > 0:
+        y = y + 10
+    return y
+
+
+def test_if_without_else():
+    g = convert_function(one_branch_only)
+    step = paddle.jit.to_static(g)
+    np.testing.assert_allclose(
+        step(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [11.0])
+    np.testing.assert_allclose(
+        step(paddle.to_tensor(np.array([-1.0], np.float32))).numpy(), [-1.0])
+
+
+def uses_return(x):
+    if x.sum() > 0:
+        return x * 2
+    return x - 1
+
+
+def test_return_in_branch_not_converted_python_fallback():
+    g, conv = _was_converted(uses_return)
+    # return bails conversion of that `if` — concrete predicates keep
+    # exact python semantics
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(g(x).numpy(), [2.0])
+
+
+def undefined_one_branch(x):
+    if x.sum() > 0:
+        v = x * 2
+    else:
+        w = x * 3   # different name
+    return v * 2
+
+
+def test_undefined_var_message():
+    g = convert_function(undefined_one_branch)
+    xn = paddle.to_tensor(np.array([-1.0], np.float32))
+    with pytest.raises(NameError, match="every path"):
+        g(xn)
+
+
+# ---------------------------------------------------------------------------
+# layer integration: reference-style net without manual cond
+# ---------------------------------------------------------------------------
+
+class BranchyNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.a = paddle.nn.Linear(4, 4)
+        self.b = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        if x.mean() > 0:
+            h = self.a(x)
+        else:
+            h = self.b(x)
+        return h.sum()
+
+
+def test_layer_forward_traced_with_param_grads():
+    paddle.seed(0)
+    net = BranchyNet()
+    fwd = convert_function(net.forward)
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = fwd(x)
+        loss.backward()
+        return loss
+
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    step(x)
+    assert net.a.weight.grad is not None
+    ga = np.asarray(net.a.weight.grad.numpy()).copy()
+    assert np.abs(ga).sum() > 0
+    net.a.weight.clear_gradient()
+    net.b.weight.clear_gradient()
+    xn = paddle.to_tensor(-np.ones((2, 4), np.float32))
+    step(xn)
+    gb = np.asarray(net.b.weight.grad.numpy())
+    assert np.abs(gb).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# ld / UndefinedVar unit behavior
+# ---------------------------------------------------------------------------
+
+def test_ld_and_undefined():
+    assert ld(lambda: 42) == 42
+    u = ld(lambda: _does_not_exist, "nope")  # noqa: F821
+    assert isinstance(u, UndefinedVar)
+    with pytest.raises(NameError, match="nope|every path"):
+        bool(u)
+
+
+def test_to_static_autoconverts_without_manual_call():
+    """@paddle.jit.to_static alone must convert control flow (reference
+    program_translator usage — no manual cond/convert_function)."""
+    net = BranchyNet()
+
+    step = paddle.jit.to_static(net.forward)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    xn = paddle.to_tensor(-np.ones((2, 4), np.float32))
+    la = float(step(x))
+    lb = float(step(xn))
+    assert len(step.program_cache) == 1  # both branches in ONE program
+    # branch outputs really differ (different layers)
+    assert la != lb
+
+
+_FLAG = 1.0
+
+
+def flag_net(x):
+    if x.sum() > 0:
+        y = x * _FLAG
+    else:
+        y = x
+    return y
+
+
+def test_converted_function_sees_rebound_globals():
+    """code-review r4: conversion must not snapshot module globals —
+    later rebindings (config flags, counters) stay visible."""
+    global _FLAG
+    g = convert_function(flag_net)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    _FLAG = 1.0
+    np.testing.assert_allclose(g(x).numpy(), [1.0])
+    _FLAG = 2.0
+    try:
+        np.testing.assert_allclose(g(x).numpy(), [2.0])
+    finally:
+        _FLAG = 1.0

@@ -1,0 +1,223 @@
+"""Elastic manager (reference: unittests/test_fleet_elastic_manager.py —
+but against a live in-memory coordinator with real lease/watch semantics
+instead of a no-op mock)."""
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (
+    ELASTIC_EXIT_CODE, ElasticLevel, ElasticManager, ElasticStatus,
+    InMemoryCoordinator, LauncherInterface)
+
+
+def mk(coord, host, np="2", level=ElasticLevel.FAULT_TOLERANCE, **kw):
+    kw.setdefault("lease_ttl", 0.4)
+    kw.setdefault("heartbeat_interval", 0.1)
+    return ElasticManager(coord, job_id="job0", np=np, curr_host=host,
+                          elastic_level=level, **kw)
+
+
+class FakeLauncher(LauncherInterface):
+    def __init__(self):
+        self.rc = None
+        self.launched = 0
+        self.stopped = 0
+
+    def launch(self):
+        self.launched += 1
+
+    def watch(self):
+        return self.rc
+
+    def stop(self):
+        self.stopped += 1
+
+
+class TestMembership:
+    def test_register_and_match_fault_tolerance(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170")
+        assert not m1._match()          # only 1 of np=2
+        m2 = mk(coord, "h2:6170")
+        assert m1._match()
+        assert m1.hosts == ["h1:6170", "h2:6170"]
+        m1.exit(); m2.exit()
+
+    def test_lease_expiry_removes_node(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170")
+        m2 = mk(coord, "h2:6170")
+        assert m1._match()
+        # kill h2's heartbeat; its lease must lapse and membership shrink
+        m2._hb_stop.set()
+        m2._hb_thread.join()
+        time.sleep(0.6)
+        coord.sweep()
+        assert not m1._match()
+        assert m1.hosts == ["h1:6170"]
+        m1.exit(); m2.exit()
+
+    def test_heartbeat_keeps_lease_alive(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170", np="1")
+        time.sleep(1.0)   # several ttl periods
+        coord.sweep()
+        assert m1._match()
+        m1.exit()
+
+    def test_watch_flags_membership_change(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170")
+        m1.need_sync = False
+        mk(coord, "h2:6170")
+        assert m1.need_sync            # watch callback fired on join
+
+
+class TestElasticWindow:
+    def test_window_waits_then_accepts(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170", np="2:4",
+                level=ElasticLevel.ELASTIC, elastic_timeout=0.3)
+        m2 = mk(coord, "h2:6170", np="2:4",
+                level=ElasticLevel.ELASTIC, elastic_timeout=0.3)
+        m3 = mk(coord, "h3:6170", np="2:4",
+                level=ElasticLevel.ELASTIC, elastic_timeout=0.3)
+        # 3 in [2,4): inside the settle window -> not yet
+        assert not m1._match()
+        time.sleep(0.35)
+        assert m1._match()             # window elapsed -> accept 3
+        m1.exit(); m2.exit(); m3.exit()
+
+    def test_max_np_launches_immediately(self):
+        coord = InMemoryCoordinator()
+        ms = [mk(coord, f"h{i}:6170", np="2:4",
+                 level=ElasticLevel.ELASTIC, elastic_timeout=30)
+              for i in range(4)]
+        assert ms[0]._match()          # at max_np: no wait
+        for m in ms:
+            m.exit()
+
+    def test_below_min_never_matches(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170", np="2:4",
+                level=ElasticLevel.ELASTIC, elastic_timeout=0.05)
+        time.sleep(0.1)
+        assert not m1._match()
+        m1.exit()
+
+
+class TestRankRegeneration:
+    def test_initial_ranks_sorted(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170")
+        m2 = mk(coord, "h2:6170")
+        assert m1.wait(timeout=2)
+        env = m1.sync()
+        assert env["PADDLE_TRAINER_ID"] == "0"
+        assert env["PADDLE_TRAINERS_NUM"] == "2"
+        env2 = m2.sync()
+        assert env2["PADDLE_TRAINER_ID"] == "1"
+        m1.exit(); m2.exit()
+
+    def test_scale_in_preserves_surviving_ranks(self):
+        """Reference contract (manager.py:490): when h0 (rank 0) leaves,
+        h1/h2 KEEP ranks 1/2 and the unseated host fills rank 0."""
+        coord = InMemoryCoordinator()
+        m = mk(coord, "h1:6170", np="3:4", level=ElasticLevel.ELASTIC,
+               elastic_timeout=0.05)
+        m.hosts = ["h0:6170", "h1:6170", "h2:6170", "h3:6170"]
+        m.trainer_hosts = []
+        m.sync()
+        assert m.trainer_hosts == [
+            "h0:6170", "h1:6170", "h2:6170", "h3:6170"]
+        # h0 drops out
+        m.hosts = ["h1:6170", "h2:6170", "h3:6170"]
+        env = m.sync()
+        # h1 keeps rank 1, h2 keeps rank 2, h3 (old rank 3, out of range)
+        # moves into the vacated rank 0
+        assert m.trainer_hosts == ["h3:6170", "h1:6170", "h2:6170"]
+        assert env["PADDLE_TRAINER_ID"] == "1"
+
+    def test_scale_out_appends_new_hosts(self):
+        coord = InMemoryCoordinator()
+        m = mk(coord, "h1:6170", np="2:4", level=ElasticLevel.ELASTIC,
+               elastic_timeout=0.05)
+        m.hosts = ["h1:6170", "h2:6170"]
+        m.trainer_hosts = []
+        m.sync()
+        assert m.trainer_hosts == ["h1:6170", "h2:6170"]
+        m.hosts = ["h1:6170", "h2:6170", "h9:6170"]
+        m.sync()
+        # old ranks unchanged; the joiner takes the new rank
+        assert m.trainer_hosts == ["h1:6170", "h2:6170", "h9:6170"]
+        assert m.np == 3
+
+    def test_endpoints_published(self):
+        coord = InMemoryCoordinator()
+        m1 = mk(coord, "h1:6170")
+        m2 = mk(coord, "h2:6170")
+        m1.wait(timeout=2)
+        m1.sync()
+        v, _ = coord.get(m1.endpoints_path)
+        assert v == b"h1:6170,h2:6170"
+        m1.exit(); m2.exit()
+
+
+class TestWatchLoop:
+    def test_completed(self):
+        coord = InMemoryCoordinator()
+        m = mk(coord, "h1:6170", np="1")
+        m.wait(timeout=2)
+        m.sync()
+        launcher = FakeLauncher()
+        m.run(launcher)
+        launcher.rc = 0
+        assert m.watch() == ElasticStatus.COMPLETED
+        assert m._completed()
+
+    def test_error(self):
+        coord = InMemoryCoordinator()
+        m = mk(coord, "h1:6170", np="1")
+        m.wait(timeout=2); m.sync()
+        launcher = FakeLauncher()
+        m.run(launcher)
+        launcher.rc = 1
+        assert m.watch() == ElasticStatus.ERROR
+        m.exit()
+
+    def test_elastic_exit_code_restarts(self):
+        coord = InMemoryCoordinator()
+        m = mk(coord, "h1:6170", np="1")
+        m.wait(timeout=2); m.sync()
+        launcher = FakeLauncher()
+        m.run(launcher)
+        launcher.rc = ELASTIC_EXIT_CODE
+        assert m.watch() == ElasticStatus.RESTART
+        m.exit()
+
+    def test_member_join_triggers_restart(self):
+        coord = InMemoryCoordinator()
+        m = mk(coord, "h1:6170", np="1:2", level=ElasticLevel.ELASTIC,
+               elastic_timeout=0.01)
+        m.wait(timeout=2); m.sync()
+        launcher = FakeLauncher()
+        m.run(launcher)
+        m2 = mk(coord, "h2:6170", np="1:2", level=ElasticLevel.ELASTIC)
+        time.sleep(0.05)
+        assert m.watch() == ElasticStatus.RESTART
+        env = m.sync()
+        assert env["PADDLE_TRAINERS_NUM"] == "2"
+        m.exit(); m2.exit()
+
+
+class TestNpParse:
+    def test_forms(self):
+        from paddle_tpu.distributed.fleet.elastic.manager import _parse_np
+
+        assert _parse_np(4) == (4, 4)
+        assert _parse_np("4") == (4, 4)
+        assert _parse_np("2:8") == (2, 8)
+        with pytest.raises(ValueError):
+            _parse_np("8:2")
+        with pytest.raises(ValueError):
+            _parse_np("0")

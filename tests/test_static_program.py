@@ -292,16 +292,46 @@ class TestShapeAttrBakeDetection:
         main = static.Program()
         with static.program_guard(main):
             x = static.data('x', [None, 4], 'float32')
-            # shape-derived attr: batch recorded as dummy 1 gets baked
+            # shape-derived attr: the dummy batch size gets baked
             y = x.reshape([x.shape[0], 2, 2]).sum()
         exe = static.Executor()
-        # feeding the dummy batch is consistent -> fine
-        out, = exe.run(main, feed={'x': np.ones((1, 4), np.float32)},
-                       fetch_list=[y])
-        np.testing.assert_allclose(np.asarray(out), 4.0)
         with pytest.raises(RuntimeError, match="baked"):
             exe.run(main, feed={'x': np.ones((8, 4), np.float32)},
                     fetch_list=[y])
+
+    def test_keepdim_one_not_false_flagged(self, static_mode):
+        """A genuinely-static size-1 dim (keepdim axis) used in an attr
+        must NOT block dynamic-batch feeds (code-review r4)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 4], 'float32')
+            m = x.sum(axis=1, keepdim=True)          # [B, 1]
+            r = (m.reshape([m.shape[1], -1])).sum()  # attr from the 1-dim
+        exe = static.Executor()
+        out, = exe.run(main, feed={'x': np.ones((8, 4), np.float32)},
+                       fetch_list=[r])
+        np.testing.assert_allclose(np.asarray(out), 32.0)
+
+    def test_baked_guard_is_per_feed(self, static_mode):
+        """A bake derived from feed `a` must not block dynamic sizes on
+        unrelated feed `b` (code-review r4)."""
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data('a', [None, 4], 'float32')
+            b = static.data('b', [None, 4], 'float32')
+            ya = a.reshape([a.shape[0], 2, 2]).sum()
+            yb = (b * 2.0).sum()
+        exe = static.Executor()
+        dummy_a = main._feed_vars['a']._data.shape[0]
+        out, = exe.run(main, feed={
+            'a': np.ones((dummy_a, 4), np.float32),   # consistent with bake
+            'b': np.ones((8, 4), np.float32),         # free to vary
+        }, fetch_list=[yb])
+        np.testing.assert_allclose(np.asarray(out), 64.0)
+        with pytest.raises(RuntimeError, match="baked"):
+            exe.run(main, feed={'a': np.ones((8, 4), np.float32),
+                                'b': np.ones((8, 4), np.float32)},
+                    fetch_list=[ya])
 
     def test_dynamic_batch_without_shape_attrs_still_works(self, static_mode):
         main = static.Program()

@@ -230,14 +230,18 @@ class TestInplaceEdgeRegressions:
         np.testing.assert_allclose(
             np.asarray(x.grad.numpy()), [np.exp(2.0) / 4.0], rtol=1e-4)
 
-    def test_consumed_then_mutated_still_raises(self):
+    def test_consumed_then_mutated_backward_correct(self):
+        # r4: consumers recorded before an in-place write are retargeted to
+        # the pre-write shadow, so this computes the CORRECT grad (the
+        # reference's version counter would raise; see
+        # tests/test_ops.py::test_backward_through_inplace_consumers)
         x = paddle.to_tensor(np.array([4.0], np.float32),
                              stop_gradient=False)
         a = x * 1
         b = a + 1.0
         a.exp_()
-        with pytest.raises(RuntimeError, match="in-place"):
-            b.sum().backward()
+        b.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0])
 
     def test_variable_isinstance(self):
         from paddle_tpu import static
