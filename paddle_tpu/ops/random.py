@@ -63,10 +63,13 @@ def randint(low=0, high=None, shape=[1], dtype="int64", name=None):
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
+    # reference semantics: dtype defaults to x's dtype, which may be a
+    # FLOAT — integer values are then stored in that float dtype
     dt = dtype_mod.convert_dtype(dtype) if dtype is not None else x.dtype
     if high is None:
         low, high = 0, low
-    return wrap(jax.random.randint(next_key(), tuple(x.shape), low, high, dtype=dt))
+    ints = jax.random.randint(next_key(), tuple(x.shape), low, high)
+    return wrap(ints.astype(dt))
 
 
 def randperm(n, dtype="int64", name=None):
