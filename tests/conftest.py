@@ -29,8 +29,10 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # Persistent compilation cache: the distributed/pipeline tests are
 # compile-bound; caching across pytest runs cuts the suite from ~10 min of
 # XLA compiles to seconds on re-runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".xla_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def pytest_configure(config):

@@ -62,6 +62,7 @@ class OpSpec:
     grad_rtol: float = 2e-2
     grad_atol: float = 1e-3
     eps: float = 1e-3
+    grad_probes: int = 32   # max finite-difference coords per input
 
     def resolve(self):
         if self.fn is not None:
@@ -138,8 +139,9 @@ def check_grad(spec: OpSpec, seed: int = 0):
         flat = base.reshape(-1)
         nflat = numeric.reshape(-1)
         # probe a bounded subset of coordinates on big inputs
-        coords = range(flat.size) if flat.size <= 64 else \
-            rs.choice(flat.size, 64, replace=False)
+        cap = spec.grad_probes
+        coords = range(flat.size) if flat.size <= cap else \
+            rs.choice(flat.size, cap, replace=False)
         probed = np.zeros(base.size, dtype=bool)
         for c in coords:
             probed[c] = True

@@ -100,5 +100,12 @@ class TestGPTHybrid:
 
 class TestGraftEntry:
     def test_dryrun_multichip_8(self):
+        # light mode: the riskiest factorization + the single-device
+        # equivalence reference; the driver runs the full 4-config sweep
+        import __graft_entry__ as ge
+        ge.dryrun_multichip(8, configs="hybrid-only")
+
+    @pytest.mark.slow
+    def test_dryrun_multichip_8_full_sweep(self):
         import __graft_entry__ as ge
         ge.dryrun_multichip(8)

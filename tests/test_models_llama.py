@@ -138,7 +138,6 @@ class TestLlamaHybridSep:
             ln = float(step(x, y))
         assert np.isfinite(ln) and ln < l0
 
-    @pytest.mark.slow
     def test_sep_matches_single_device(self, hybrid_sep):
         """Loss under sep-sharded execution equals unsharded execution
         (GSPMD partitioning must not change the math)."""

@@ -59,7 +59,6 @@ class TestPipelineSchedule:
         np.testing.assert_allclose(model(x).numpy(), pipe(x).numpy(),
                                    atol=1e-5)
 
-    @pytest.mark.slow
     def test_grads_match_sequential(self, hybrid_pp):
         pipe, model = _build(hybrid_pp)
         rs = np.random.RandomState(1)
@@ -99,7 +98,6 @@ class TestPipelineSchedule:
         with pytest.raises(ValueError):
             model(x)
 
-    @pytest.mark.slow
     def test_gpt_pipe_model(self, hybrid_pp):
         hcg, _ = hybrid_pp
         from paddle_tpu.models import gpt_tiny, GPTForCausalLMPipe
@@ -282,7 +280,6 @@ class TestInterleavedSchedule:
         np.testing.assert_allclose(model(x).numpy(), pipe(x).numpy(),
                                    atol=1e-5)
 
-    @pytest.mark.slow
     def test_grads_match_sequential(self, hybrid_pp):
         pipe, model = self._build(hybrid_pp, 2)
         rs = np.random.RandomState(1)

@@ -73,7 +73,6 @@ class TestRingVsOracle:
 
 
 class TestSepModelGradEquivalence:
-    @pytest.mark.slow
     def test_gpt_sep2_grads_match_sep1(self):
         """Full model: loss AND parameter grads identical under sep=2 vs
         unsharded (the GSPMD/ring partitioning must not change math)."""
@@ -91,8 +90,16 @@ class TestSepModelGradEquivalence:
             rs = np.random.RandomState(0)
             x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (2, 16)))
             y = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (2, 16)))
-            loss = crit(model(x), y)
-            loss.backward()
+
+            # compiled path: the production route for sep (and ~7x faster
+            # than eager per-op dispatch on the virtual mesh)
+            @paddle.jit.to_static
+            def step(x, y):
+                loss = crit(model(x), y)
+                loss.backward()
+                return loss
+
+            loss = step(x, y)
             grads = {n: np.asarray(p.grad)
                      for n, p in model.named_parameters()
                      if p.grad is not None}

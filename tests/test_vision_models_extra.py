@@ -53,6 +53,7 @@ def test_inception_v3_forward():
     assert tuple(out.shape) == (1, 10)
 
 
+@pytest.mark.slow
 def test_train_step_squeezenet():
     paddle.seed(0)
     net = M.squeezenet1_1(num_classes=4)
