@@ -100,7 +100,33 @@ class DataParallel(Layer):
         return loss  # XLA mean over the global batch already matches 1-chip
 
     def no_sync(self):
+        """Gradient-accumulation window without per-step grad sync
+        (reference: parallel.py no_sync skipping EagerReducer allreduce).
+
+        Under GSPMD the DP all-reduce is not a separable step: it is
+        fused into each gradient's computation by the partitioner, and
+        when the accumulation loop is compiled into one program XLA
+        already defers/merges the collectives — the optimization no_sync
+        exists for happens automatically.  In eager multi-controller use
+        the per-step reduce cannot be elided without changing the
+        parameter layout, so the contract is approximated (grads are
+        synced every step; values remain CORRECT, only the comm saving
+        is lost) — warn once so the difference is not silent."""
         import contextlib
+
+        if jax.process_count() > 1 and not getattr(
+                DataParallel, "_warned_no_sync", False):
+            import warnings
+
+            DataParallel._warned_no_sync = True
+            warnings.warn(
+                "DataParallel.no_sync: under the GSPMD engine gradients "
+                "are reduced as part of their computation; inside a "
+                "compiled train step XLA merges the collectives across "
+                "the accumulation window (the saving no_sync exists "
+                "for), but in eager multi-process mode each backward "
+                "still syncs — values are correct, the comm saving is "
+                "not realized")
         return contextlib.nullcontext()
 
     def state_dict(self, *a, **k):

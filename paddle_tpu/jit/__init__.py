@@ -219,9 +219,28 @@ def save(layer, path, input_spec=None, **configs):
 
     state_arrays = [state[n]._value() for n in names]
     in_arrays = [t._value() for t in in_tensors]
+    # None/-1 InputSpec dims export as SYMBOLIC dimensions (shared scope):
+    # the served model accepts any size there (reference
+    # save_inference_model's -1 dims; jax shape polymorphism)
+    scope = jax.export.SymbolicScope()
+    sym_iter = iter(f"_d{i}" for i in range(64))
+    in_avals = []
+    for spec_i, arr in zip(list(input_spec) + [None] * len(in_arrays),
+                           in_arrays):
+        declared = list(getattr(spec_i, "shape", arr.shape))
+        if any(d is None or (isinstance(d, int) and d < 0)
+               for d in declared):
+            dims = ",".join(
+                next(sym_iter) if (d is None or int(d) < 0) else str(int(d))
+                for d in declared)
+            shp = jax.export.symbolic_shape(dims, scope=scope)
+            in_avals.append(jax.ShapeDtypeStruct(shp, arr.dtype))
+        else:
+            in_avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
     exported = jax.export.export(jax.jit(pure))(
-        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state_arrays),
-        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), in_arrays),
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                     state_arrays),
+        in_avals,
     )
     blob = exported.serialize()
     d = os.path.dirname(path)

@@ -10,9 +10,16 @@ from ..core import dtype as dtype_mod
 
 
 def _as_int(x):
-    """int() that keeps the static-recording shape taint (SymbolicDim) so
-    attrs computed from feed-derived dims stay detectable."""
-    return x if isinstance(x, SymbolicDim) else int(x)
+    """int() that keeps the static-recording shape taint (SymbolicDim)
+    and jax symbolic dimensions (shape-polymorphic jit.save export)."""
+    if isinstance(x, SymbolicDim):
+        return x
+    try:
+        return int(x)
+    except Exception:
+        # jax.export symbolic dimension (_DimExpr raises
+        # InconclusiveDimensionOperation on int()): pass through
+        return x
 
 
 def unwrap(x):
@@ -41,7 +48,9 @@ def paddle_reshape_shape(orig_shape, shape):
     out = []
     for i, s in enumerate(shape):
         s = _as_int(s)
-        if s == 0:
+        # `s == 0` on a jax symbolic dim raises (cannot be decided for
+        # all sizes); symbolic dims are never the 0 keep-marker
+        if isinstance(s, int) and s == 0:
             out.append(orig_shape[i])
         else:
             out.append(s)
