@@ -21,13 +21,17 @@ def main():
     import inspect
 
     import paddle_tpu as paddle
-    from test_op_suite import SPECS
+    from test_op_suite import SPECS as SPECS1
+    from test_op_suite_extra import SPECS2
+
+    SPECS = list(SPECS1) + list(SPECS2)
 
     lines = [
         "# paddle_tpu op reference",
         "",
-        "Generated from the op-schema table (`tests/test_op_suite.py` "
-        "SPECS) by `tools/gen_op_docs.py` — the same rows drive the "
+        "Generated from the op-schema tables (`tests/test_op_suite.py` "
+        "+ `test_op_suite_extra.py`) by `tools/gen_op_docs.py` — the "
+        "same rows drive the "
         "OpTest harness (forward vs numpy oracle, analytic-vs-numeric "
         "gradients, dtype sweeps, Tensor-method binding).",
         "",

@@ -1,0 +1,88 @@
+"""MXU efficiency probe for the 345M bench's exact GEMM population.
+
+Answers "why do the main matmuls run at ~55%?" (docs/PERF.md) with three
+controlled experiments on the real chip:
+
+  A. each model GEMM shape, fwd orientation, bf16 x bf16 -> bf16
+  B. the bwd orientations (dW = x^T dy, dx = dy W^T) — relayout cost
+  C. f32 vs bf16 epilogues (preferred_element_type) — cast-fusion cost
+
+Timing recipe per the axon-tunnel contract (block_until_ready lies):
+N iterations inside ONE jit via lax.scan with per-iteration input
+perturbation, one scalar readback, minus one measured RPC.
+
+Usage:  PYTHONPATH=/root/.axon_site:/root/repo python tools/mxu_probe.py
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+B, S, H, F, V = 8, 1024, 1024, 4096, 50304
+M = B * S
+
+# (name, lhs_shape, rhs_shape, contract) — the per-layer GEMM population
+# of GPT-2 345M fwd+bwd (24 layers x these, + embedding/CE handled by
+# their own kernels)
+SHAPES = [
+    ("qkv_fwd",   (M, H), (H, 3 * H)),
+    ("attnout",   (M, H), (H, H)),
+    ("mlp_up",    (M, H), (H, F)),
+    ("mlp_down",  (M, F), (F, H)),
+    ("dW_up",     (H, M), (M, F)),      # x^T · dy
+    ("dx_down",   (M, H), (H, F)),      # dy · W^T (same shape class)
+]
+
+
+def bench_gemm(jax, jnp, lhs_shape, rhs_shape, out_dtype, iters=30):
+    from jax import lax
+
+    key = jax.random.PRNGKey(0)
+    lhs = jax.random.normal(key, lhs_shape, jnp.bfloat16)
+    rhs = jax.random.normal(key, rhs_shape, jnp.bfloat16)
+
+    @jax.jit
+    def run(lhs, rhs):
+        def body(carry, i):
+            l = lhs + i.astype(jnp.bfloat16) * 1e-6   # defeat CSE
+            o = lax.dot_general(
+                l, rhs, (((1,), (0,)), ((), ())),
+                preferred_element_type=out_dtype)
+            return carry + o[0, 0].astype(jnp.float32), ()
+
+        acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(iters))
+        return acc
+
+    # warm + compile
+    float(run(lhs, rhs))
+    # one RPC floor measurement
+    t0 = time.perf_counter()
+    float(run(lhs, rhs))
+    total = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _ = float(jnp.float32(1.0) + 1)
+    rpc = time.perf_counter() - t0
+    per_iter = max(total - rpc, 1e-9) / iters
+    flops = 2 * lhs_shape[0] * lhs_shape[1] * rhs_shape[1]
+    return per_iter, flops / per_iter
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    peak = 197e12 if "v5" in dev.device_kind.lower() else 197e12
+    print(f"device: {dev.device_kind}, assuming bf16 peak {peak/1e12:.0f} TF/s")
+    print(f"{'gemm':>10} {'epilogue':>8} {'ms':>8} {'TF/s':>8} {'MXU%':>6}")
+    for name, a, b in SHAPES:
+        for out_dtype, tag in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
+            dt, fs = bench_gemm(jax, jnp, a, b, out_dtype)
+            print(f"{name:>10} {tag:>8} {dt*1e3:>8.3f} {fs/1e12:>8.1f} "
+                  f"{100*fs/peak:>5.1f}%")
+
+
+if __name__ == "__main__":
+    main()
