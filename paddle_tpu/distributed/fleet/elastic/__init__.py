@@ -1,11 +1,13 @@
 """Elastic training (reference: python/paddle/distributed/fleet/elastic)."""
 from .manager import (  # noqa: F401
     ELASTIC_EXIT_CODE, ELASTIC_TIMEOUT, ELASTIC_TTL, ElasticLevel,
-    ElasticManager, ElasticStatus, InMemoryCoordinator, LauncherInterface,
+    ElasticManager, ElasticStatus, FileCoordinator, InMemoryCoordinator,
+    LauncherInterface,
 )
 
 __all__ = [
     "ElasticManager", "ElasticLevel", "ElasticStatus", "LauncherInterface",
-    "InMemoryCoordinator", "ELASTIC_TIMEOUT", "ELASTIC_TTL",
+    "InMemoryCoordinator", "FileCoordinator", "ELASTIC_TIMEOUT",
+    "ELASTIC_TTL",
     "ELASTIC_EXIT_CODE",
 ]

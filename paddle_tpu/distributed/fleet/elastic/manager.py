@@ -400,3 +400,186 @@ class ElasticManager:
         except Exception:
             pass
         self.coord.delete(self.node_prefix + self.curr_host)
+
+
+class FileCoordinator:
+    """Cross-process coordinator over a shared directory (the etcd duck
+    for single-host / shared-filesystem pods — reference deployments
+    point ElasticManager at etcd; this needs nothing but a path).
+
+    Keys are files; a leased key is alive while its mtime is fresher
+    than its ttl (heartbeat refresh = touch).  Watches poll the
+    directory version; real etcd pushes, so keep poll_interval small.
+    """
+
+    def __init__(self, root: str, poll_interval: float = 0.05):
+        import os
+
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+        self._poll = poll_interval
+        self._watches: Dict[int, Tuple[str, Callable]] = {}
+        self._next_watch = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- paths ------------------------------------------------------------
+    def _path(self, key: str) -> str:
+        import os
+        from urllib.parse import quote
+
+        return os.path.join(self._root, quote(key, safe=""))
+
+    def _key(self, fname: str) -> str:
+        from urllib.parse import unquote
+
+        return unquote(fname)
+
+    # -- kv ---------------------------------------------------------------
+    def put(self, key: str, value, lease: Optional["_FileLease"] = None):
+        import json
+        import os
+
+        value = value if isinstance(value, bytes) else str(value).encode()
+        rec = {"v": value.decode("latin1"),
+               "ttl": lease.ttl if lease is not None else None}
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, self._path(key))
+        if lease is not None:
+            lease.key = key
+            lease._coord = self
+
+    def _read(self, path: str):
+        import json
+        import os
+        import time as _t
+
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if rec.get("ttl") is not None:
+                age = _t.time() - os.path.getmtime(path)
+                if age > rec["ttl"]:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    return None
+            return rec["v"].encode("latin1")
+        except (OSError, ValueError):
+            return None
+
+    def get(self, key: str):
+        return self._read(self._path(key)), key
+
+    def get_prefix(self, prefix: str):
+        import os
+
+        out = []
+        for fname in sorted(os.listdir(self._root)):
+            if fname.endswith(".tmp"):
+                continue
+            key = self._key(fname)
+            if key.startswith(prefix):
+                v = self._read(os.path.join(self._root, fname))
+                if v is not None:
+                    out.append((v, key))
+        return out
+
+    def delete(self, key: str):
+        import os
+
+        try:
+            os.unlink(self._path(key))
+            return True
+        except OSError:
+            return False
+
+    # -- leases ------------------------------------------------------------
+    def lease(self, ttl: float) -> "_FileLease":
+        return _FileLease(self, ttl)
+
+    def sweep(self):
+        import os
+
+        for fname in list(os.listdir(self._root)):
+            if not fname.endswith(".tmp"):
+                self._read(os.path.join(self._root, fname))
+
+    # -- watches -----------------------------------------------------------
+    def _snapshot(self):
+        import os
+
+        snap = {}
+        for fname in os.listdir(self._root):
+            if fname.endswith(".tmp"):
+                continue
+            try:
+                snap[fname] = os.path.getmtime(
+                    os.path.join(self._root, fname))
+            except OSError:
+                pass
+        return snap
+
+    def _watch_loop(self):
+        prev = self._snapshot()
+        while not self._stop.wait(self._poll):
+            cur = self._snapshot()
+            changed = [f for f in set(prev) | set(cur)
+                       if prev.get(f) != cur.get(f)]
+            prev = cur
+            if not changed:
+                continue
+            with self._lock:
+                watches = list(self._watches.values())
+            for fname in changed:
+                key = self._key(fname)
+                for pfx, cb in watches:
+                    if key.startswith(pfx):
+                        try:
+                            cb(key)
+                        except Exception:
+                            pass
+
+    def add_watch_prefix_callback(self, prefix: str, callback) -> int:
+        with self._lock:
+            self._next_watch += 1
+            self._watches[self._next_watch] = (prefix, callback)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._watch_loop, daemon=True)
+                self._thread.start()
+            return self._next_watch
+
+    def cancel_watch(self, watch_id: int):
+        with self._lock:
+            self._watches.pop(watch_id, None)
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class _FileLease:
+    def __init__(self, coord: FileCoordinator, ttl: float):
+        self._coord = coord
+        self.ttl = float(ttl)
+        self.key = None
+        self.revoked = False
+
+    def refresh(self):
+        import os
+
+        if self.revoked:
+            raise RuntimeError("lease revoked")
+        if self.key is not None:
+            os.utime(self._coord._path(self.key))
+
+    def revoke(self):
+        self.revoked = True
+        if self.key is not None:
+            self._coord.delete(self.key)

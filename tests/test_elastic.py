@@ -221,3 +221,80 @@ class TestNpParse:
             _parse_np("8:2")
         with pytest.raises(ValueError):
             _parse_np("0")
+
+
+class TestFileCoordinator:
+    """Cross-process coordinator over a shared directory: the same
+    ElasticManager code that takes etcd in pods runs single-host with
+    nothing but a path."""
+
+    def test_managers_in_separate_processes(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        import textwrap
+
+        root = str(tmp_path / "coord")
+        child_src = textwrap.dedent(f"""
+            import time
+            from paddle_tpu.distributed.fleet.elastic import (
+                ElasticManager, FileCoordinator)
+
+            c = FileCoordinator({root!r}, poll_interval=0.05)
+            m = ElasticManager(c, "job", np="2", curr_host="hB:6170",
+                               lease_ttl=2.0, heartbeat_interval=0.2)
+            deadline = time.time() + 10
+            while time.time() < deadline and not m._match():
+                time.sleep(0.05)
+            env = m.sync()
+            print("CHILD_RANK", env["PADDLE_TRAINER_ID"], flush=True)
+            time.sleep(1.0)
+            m.exit()
+        """)
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        child = subprocess.Popen([sys.executable, "-c", child_src],
+                                 env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            from paddle_tpu.distributed.fleet.elastic import (
+                ElasticManager, FileCoordinator)
+
+            c = FileCoordinator(root, poll_interval=0.05)
+            m = ElasticManager(c, "job", np="2", curr_host="hA:6170",
+                               lease_ttl=2.0, heartbeat_interval=0.2)
+            assert m.wait(timeout=10)
+            env_a = m.sync()
+            assert env_a["PADDLE_TRAINER_ID"] == "0"     # hA sorts first
+            assert env_a["PADDLE_TRAINERS_NUM"] == "2"
+            out, _ = child.communicate(timeout=20)
+            assert "CHILD_RANK 1" in out
+            m.exit()
+            c.close()
+        finally:
+            if child.poll() is None:
+                child.kill()
+
+    def test_lease_expiry_across_restart(self, tmp_path):
+        import time
+
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, FileCoordinator)
+
+        root = str(tmp_path / "coord2")
+        c = FileCoordinator(root, poll_interval=0.05)
+        m1 = ElasticManager(c, "job", np="1:2", curr_host="h1:1",
+                            lease_ttl=0.4, heartbeat_interval=0.1,
+                            elastic_timeout=0.05)
+        m2 = ElasticManager(c, "job", np="1:2", curr_host="h2:1",
+                            lease_ttl=0.4, heartbeat_interval=0.1,
+                            elastic_timeout=0.05)
+        assert m1.wait(timeout=5)
+        # kill m2's heartbeat: its file lease must go stale and drop out
+        m2._hb_stop.set()
+        m2._hb_thread.join()
+        time.sleep(0.8)
+        c.sweep()
+        assert m1._current_hosts() == ["h1:1"]
+        m1.exit(); m2.exit(); c.close()
