@@ -15,9 +15,13 @@ contract: surviving hosts keep their rank wherever possible.
 """
 from __future__ import annotations
 
+import itertools
+import json
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import quote, unquote
 
 ELASTIC_TIMEOUT = 120            # elastic window (reference :41)
 ELASTIC_TTL = 60                 # node lease ttl seconds
@@ -407,14 +411,23 @@ class FileCoordinator:
     for single-host / shared-filesystem pods — reference deployments
     point ElasticManager at etcd; this needs nothing but a path).
 
-    Keys are files; a leased key is alive while its mtime is fresher
-    than its ttl (heartbeat refresh = touch).  Watches poll the
-    directory version; real etcd pushes, so keep poll_interval small.
+    Keys are files holding {"v", "ttl", "ts"}; a leased key is alive
+    while its RECORD timestamp (written by the owner, not filesystem
+    mtime — NFS servers stamp their own clock) is fresher than its ttl;
+    heartbeat refresh rewrites the record.  Watches poll and diff the
+    directory by key VALUE, so heartbeats do not fire membership events
+    (etcd keepalives emit no watch events either).  Readers never delete
+    stale entries (no cross-process TOCTOU); they just treat them as
+    absent — only the explicit ``sweep()`` garbage-collects.
+
+    Caveat: liveness compares the writer's wall clock against the
+    reader's; keep node clocks NTP-synced within a fraction of the ttl
+    (etcd has the same requirement for its own lease clocks).
     """
 
-    def __init__(self, root: str, poll_interval: float = 0.05):
-        import os
+    _TMP_PREFIX = ".tmp-"
 
+    def __init__(self, root: str, poll_interval: float = 0.05):
         self._root = root
         os.makedirs(root, exist_ok=True)
         self._poll = poll_interval
@@ -423,75 +436,73 @@ class FileCoordinator:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._tmp_seq = itertools.count()
 
     # -- paths ------------------------------------------------------------
     def _path(self, key: str) -> str:
-        import os
-        from urllib.parse import quote
-
-        return os.path.join(self._root, quote(key, safe=""))
+        fname = quote(key, safe="")
+        if fname.startswith(self._TMP_PREFIX):
+            raise ValueError(f"key {key!r} collides with the temp-file "
+                             "namespace")
+        return os.path.join(self._root, fname)
 
     def _key(self, fname: str) -> str:
-        from urllib.parse import unquote
-
         return unquote(fname)
 
-    # -- kv ---------------------------------------------------------------
-    def put(self, key: str, value, lease: Optional["_FileLease"] = None):
-        import json
-        import os
+    def _is_tmp(self, fname: str) -> bool:
+        return fname.startswith(self._TMP_PREFIX)
 
-        value = value if isinstance(value, bytes) else str(value).encode()
-        rec = {"v": value.decode("latin1"),
-               "ttl": lease.ttl if lease is not None else None}
-        tmp = self._path(key) + ".tmp"
+    # -- kv ---------------------------------------------------------------
+    def _write(self, key: str, rec: dict):
+        # per-writer unique temp name in a reserved namespace, atomic
+        # publish via rename (concurrent puts of one key serialize on
+        # os.replace; last writer wins, never a torn record)
+        tmp = os.path.join(
+            self._root,
+            f"{self._TMP_PREFIX}{os.getpid()}-{next(self._tmp_seq)}")
         with open(tmp, "w") as f:
             json.dump(rec, f)
         os.replace(tmp, self._path(key))
+
+    def put(self, key: str, value, lease: Optional["_FileLease"] = None):
+        value = value if isinstance(value, bytes) else str(value).encode()
+        rec = {"v": value.decode("latin1"),
+               "ttl": lease.ttl if lease is not None else None,
+               "ts": time.time()}
+        self._write(key, rec)
         if lease is not None:
             lease.key = key
             lease._coord = self
+            lease._rec = rec
 
-    def _read(self, path: str):
-        import json
-        import os
-        import time as _t
-
+    def _load(self, path: str):
+        """(record, alive) — never mutates the store."""
         try:
             with open(path) as f:
                 rec = json.load(f)
-            if rec.get("ttl") is not None:
-                age = _t.time() - os.path.getmtime(path)
-                if age > rec["ttl"]:
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
-                    return None
-            return rec["v"].encode("latin1")
         except (OSError, ValueError):
-            return None
+            return None, False
+        if rec.get("ttl") is not None and                 time.time() - rec.get("ts", 0) > rec["ttl"]:
+            return rec, False
+        return rec, True
 
     def get(self, key: str):
-        return self._read(self._path(key)), key
+        rec, alive = self._load(self._path(key))
+        return (rec["v"].encode("latin1") if alive else None), key
 
     def get_prefix(self, prefix: str):
-        import os
-
         out = []
         for fname in sorted(os.listdir(self._root)):
-            if fname.endswith(".tmp"):
+            if self._is_tmp(fname):
                 continue
             key = self._key(fname)
             if key.startswith(prefix):
-                v = self._read(os.path.join(self._root, fname))
-                if v is not None:
-                    out.append((v, key))
+                rec, alive = self._load(os.path.join(self._root, fname))
+                if alive:
+                    out.append((rec["v"].encode("latin1"), key))
         return out
 
     def delete(self, key: str):
-        import os
-
         try:
             os.unlink(self._path(key))
             return True
@@ -503,25 +514,34 @@ class FileCoordinator:
         return _FileLease(self, ttl)
 
     def sweep(self):
-        import os
-
+        """Garbage-collect expired leased entries.  Guard against the
+        owner refreshing concurrently: re-read after the stale verdict
+        and only unlink if STILL stale."""
         for fname in list(os.listdir(self._root)):
-            if not fname.endswith(".tmp"):
-                self._read(os.path.join(self._root, fname))
+            if self._is_tmp(fname):
+                continue
+            path = os.path.join(self._root, fname)
+            rec, alive = self._load(path)
+            if rec is None or alive or rec.get("ttl") is None:
+                continue
+            rec2, alive2 = self._load(path)
+            if rec2 is not None and not alive2                     and rec2.get("ts") == rec.get("ts"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     # -- watches -----------------------------------------------------------
     def _snapshot(self):
-        import os
-
+        """fname -> (value, alive): VALUE-based so lease refreshes (ts
+        rewrites) do not register as membership events."""
         snap = {}
         for fname in os.listdir(self._root):
-            if fname.endswith(".tmp"):
+            if self._is_tmp(fname):
                 continue
-            try:
-                snap[fname] = os.path.getmtime(
-                    os.path.join(self._root, fname))
-            except OSError:
-                pass
+            rec, alive = self._load(os.path.join(self._root, fname))
+            if rec is not None:
+                snap[fname] = (rec.get("v"), alive)
         return snap
 
     def _watch_loop(self):
@@ -570,14 +590,18 @@ class _FileLease:
         self.ttl = float(ttl)
         self.key = None
         self.revoked = False
+        self._rec = None
 
     def refresh(self):
-        import os
-
         if self.revoked:
             raise RuntimeError("lease revoked")
-        if self.key is not None:
-            os.utime(self._coord._path(self.key))
+        if self.key is not None and self._rec is not None:
+            # rewrite the record with a fresh owner timestamp (content
+            # "v" unchanged, so value-based watches stay quiet)
+            rec = dict(self._rec)
+            rec["ts"] = time.time()
+            self._coord._write(self.key, rec)
+            self._rec = rec
 
     def revoke(self):
         self.revoked = True

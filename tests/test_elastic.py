@@ -298,3 +298,22 @@ class TestFileCoordinator:
         c.sweep()
         assert m1._current_hosts() == ["h1:1"]
         m1.exit(); m2.exit(); c.close()
+
+    def test_heartbeats_do_not_fire_membership_events(self, tmp_path):
+        """code-review r4: lease refreshes must not look like membership
+        churn, or a stable cluster restarts itself every heartbeat."""
+        import time
+
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, FileCoordinator)
+
+        root = str(tmp_path / "coord3")
+        c = FileCoordinator(root, poll_interval=0.03)
+        m = ElasticManager(c, "job", np="1", curr_host="h1:1",
+                           lease_ttl=0.3, heartbeat_interval=0.05)
+        assert m.wait(timeout=5)
+        m.sync()
+        m.need_sync = False
+        time.sleep(0.5)          # ~10 heartbeats, several watch polls
+        assert not m.need_sync
+        m.exit(); c.close()
