@@ -60,7 +60,7 @@ from .ops.logic import is_tensor
 from . import (  # noqa: F401
     nn, optimizer, amp, io, jit, vision, metric, distributed, autograd,
     framework, profiler, incubate, hapi, static, text, utils, inference,
-    distribution, fft, signal, regularizer, hub, version, sparse,
+    distribution, fft, signal, regularizer, hub, version, sparse, onnx,
 )
 
 __version__ = version.full_version

@@ -231,3 +231,33 @@ class Hessian:
         import numpy as np
 
         return np.asarray(self._compute())
+
+
+# -- primitive-mode toggles (reference: incubate/autograd/primx.py
+# enable_prim/disable_prim — a CINN-era whole-graph primitive lowering).
+# On TPU, jax's jaxpr primitives ARE the primitive IR and XLA lowers
+# them always; the toggle is honored as state (some reference code
+# branches on prim_enabled()) but changes nothing about lowering.
+
+_prim_enabled = False
+
+
+def enable_prim():
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled() -> bool:
+    return _prim_enabled
+
+
+def prim2orig(block=None):
+    """Reference: rewrite primitive ops back to original ops in a static
+    block.  There is no separate primitive block here (jaxprs lower
+    directly), so this is an intentional no-op."""
+    return None

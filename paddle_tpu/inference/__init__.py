@@ -22,6 +22,9 @@ from ..core.tensor import Tensor as _FrameworkTensor
 from .. import jit as jit_mod
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "DataType", "PredictorPool", "get_version",
+           "get_num_bytes_of_data_type", "get_trt_compile_version",
+           "get_trt_runtime_version",
            "PrecisionType", "PlaceType"]
 
 
@@ -191,3 +194,54 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """Reference paddle_infer.DataType enum."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+_DTYPE_BYTES = {
+    DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+    DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+    DataType.BFLOAT16: 2,
+}
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    return _DTYPE_BYTES[int(dtype)]
+
+
+def get_version() -> str:
+    from .. import version
+
+    return f"paddle_tpu inference {version.full_version}"
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)   # TensorRT n/a on TPU; XLA is the backend compiler
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+class PredictorPool:
+    """N independent predictors over one artifact (reference
+    paddle_infer.PredictorPool; here each slot shares the loaded
+    executable, which is stateless)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(max(int(size), 1))]
+
+    def retrive(self, idx: int) -> Predictor:   # reference spells it this way
+        return self._preds[idx]
+
+    retrieve = retrive

@@ -1,0 +1,26 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py — a thin wrapper
+that requires the external ``paddle2onnx`` package at call time).
+
+TPU-native note: the in-tree deployment format is ``jit.save``'s
+serialized StableHLO (jax.export), which is the XLA-ecosystem
+equivalent; ONNX conversion would go StableHLO→ONNX via external
+tooling.  Like the reference without paddle2onnx installed, ``export``
+raises with instructions.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "paddle.onnx.export requires the external 'paddle2onnx' "
+            "converter (the reference has the same runtime dependency). "
+            "For TPU-native deployment use paddle.jit.save, which "
+            "serializes the program as portable StableHLO.")
+    raise NotImplementedError(
+        "paddle2onnx does not understand the TPU build's StableHLO "
+        "artifacts; export via jit.save + external StableHLO->ONNX "
+        "tooling")

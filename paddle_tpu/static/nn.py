@@ -22,7 +22,10 @@ from ..core import autograd
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
 
-__all__ = ["cond", "while_loop"]
+from .nn_compat import *  # noqa: F401,F403 — fluid-style builders
+from . import nn_compat as _nn_compat
+
+__all__ = ["cond", "while_loop"] + list(_nn_compat.__all__)
 
 
 def _is_traced(t) -> bool:

@@ -1,0 +1,333 @@
+"""paddle.static.nn — fluid-style functional layer builders.
+
+Reference: python/paddle/static/nn/__init__.py (re-exporting
+fluid/layers/nn.py builders: fc:195, conv2d:1451, embedding, batch_norm,
+…) and fluid/layers/control_flow.py (case:2565, switch_case:3684,
+py_func).
+
+TPU-native design: every builder is a thin functional veneer over the
+paddle_tpu.nn layer (parameters created through create_parameter so they
+register with the active static Program) — the reference's LayerHelper
+append_op machinery is the dispatch recorder here.  The LoD ``sequence_*``
+family and the sampled-softmax/CRF ops are legacy variable-length-tensor
+APIs with no 2.x tensor equivalent; they raise with the descope reason
+(pad + mask via paddle.nn instead).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "batch_norm", "instance_norm", "layer_norm",
+    "group_norm", "data_norm", "spectral_norm", "deform_conv2d", "prelu",
+    "bilinear_tensor_product", "case", "switch_case", "py_func",
+    "crf_decoding", "nce", "multi_box_head", "row_conv",
+    "sparse_embedding",
+    "sequence_concat", "sequence_conv", "sequence_enumerate",
+    "sequence_expand", "sequence_expand_as", "sequence_first_step",
+    "sequence_last_step", "sequence_pad", "sequence_pool",
+    "sequence_reshape", "sequence_reverse", "sequence_scatter",
+    "sequence_slice", "sequence_softmax", "sequence_unpad",
+]
+
+
+def _param(shape, dtype="float32", attr=None, is_bias=False):
+    from .. import create_parameter
+
+    return create_parameter(
+        list(shape), dtype,
+        default_initializer=None if not is_bias else _zeros_init())
+
+
+def _zeros_init():
+    from ..nn.initializer import Constant
+
+    return Constant(0.0)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected over flattened trailing dims (reference
+    fluid/layers/nn.py fc)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    xs = [x] if isinstance(x, Tensor) else list(x)
+    outs = []
+    for xi in xs:
+        shape = xi.shape
+        in_dim = int(np.prod(shape[num_flatten_dims:]))
+        flat = xi.reshape(list(shape[:num_flatten_dims]) + [in_dim])
+        w = _param([in_dim, size], str(xi.dtype))
+        outs.append(flat.matmul(w))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if bias_attr is not False:
+        b = _param([size], str(xs[0].dtype), is_bias=True)
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    import paddle_tpu.nn.functional as F
+
+    w = _param(list(size), dtype)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def _conv(ndim, transpose):
+    import paddle_tpu.nn.functional as F
+
+    fn = {
+        (2, False): F.conv2d, (2, True): F.conv2d_transpose,
+        (3, False): F.conv3d, (3, True): F.conv3d_transpose,
+    }[(ndim, transpose)]
+
+    def builder(input, num_filters, filter_size, stride=1, padding=0,
+                dilation=1, groups=1, param_attr=None, bias_attr=None,
+                use_cudnn=True, act=None, name=None,
+                output_size=None, data_format="NCHW" if ndim == 2
+                else "NCDHW"):
+        import paddle_tpu.nn.functional as F
+
+        c_in = input.shape[1]
+        ks = [filter_size] * ndim if isinstance(filter_size, int) \
+            else list(filter_size)
+        g = max(int(groups or 1), 1)
+        if transpose:
+            w = _param([c_in, num_filters // g] + ks, str(input.dtype))
+        else:
+            w = _param([num_filters, c_in // g] + ks, str(input.dtype))
+        b = None
+        if bias_attr is not False:
+            b = _param([num_filters], str(input.dtype), is_bias=True)
+        kw = dict(stride=stride, padding=padding, groups=g)
+        if not transpose:
+            kw["dilation"] = dilation
+        out = fn(input, w, bias=b, **kw)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    return builder
+
+
+conv2d = _conv(2, False)
+conv2d_transpose = _conv(2, True)
+conv3d = _conv(3, False)
+conv3d_transpose = _conv(3, True)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False, is_test=False):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    c = input.shape[1]
+    w = _param([c], str(input.dtype))
+    b = _param([c], str(input.dtype), is_bias=True)
+    rm = paddle.zeros([c], str(input.dtype))
+    rv = paddle.ones([c], str(input.dtype))
+    out = F.batch_norm(input, rm, rv, weight=w, bias=b,
+                       training=not (is_test or use_global_stats),
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    import paddle_tpu.nn.functional as F
+
+    c = input.shape[1]
+    w = None if param_attr is False else _param([c], str(input.dtype))
+    b = None if bias_attr is False else _param([c], str(input.dtype),
+                                               is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    import paddle_tpu.nn.functional as F
+
+    shape = input.shape[begin_norm_axis:]
+    n = int(np.prod(shape))
+    w = _param([n], str(input.dtype)) if scale else None
+    b = _param([n], str(input.dtype), is_bias=True) if shift else None
+    flat_norm = list(input.shape[:begin_norm_axis]) + [n]
+    out = F.layer_norm(input.reshape(flat_norm), n, weight=w, bias=b,
+                       epsilon=epsilon).reshape(list(input.shape))
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    import paddle_tpu.nn.functional as F
+
+    c = input.shape[1]
+    w = None if param_attr is False else _param([c], str(input.dtype))
+    b = None if bias_attr is False else _param([c], str(input.dtype),
+                                               is_bias=True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, **kwargs):
+    """Batch-statistics-free normalization (reference data_norm: running
+    sums learned as parameters)."""
+    mean = input.mean(axis=0, keepdim=True)
+    std = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (std + epsilon).sqrt()
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.layer.norm import SpectralNorm
+
+    sn = SpectralNorm(weight.shape, axis=dim, power_iters=power_iters,
+                      epsilon=eps)
+    return sn(weight)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as _dc
+
+    c_in = input.shape[1]
+    ks = [filter_size] * 2 if isinstance(filter_size, int) \
+        else list(filter_size)
+    w = _param([num_filters, c_in // max(groups, 1)] + ks,
+               str(input.dtype))
+    b = None
+    if bias_attr is not False:
+        b = _param([num_filters], str(input.dtype), is_bias=True)
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    import paddle_tpu.nn.functional as F
+
+    if mode == "all":
+        n = 1
+    elif mode == "channel":
+        n = x.shape[1]
+    else:
+        n = int(np.prod(x.shape[1:]))
+    w = _param([n], str(x.dtype))
+    return F.prelu(x, w)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    import paddle_tpu.nn.functional as F
+
+    w = _param([size, x.shape[-1], y.shape[-1]], str(x.dtype))
+    b = None
+    if bias_attr is not False:
+        b = _param([size], str(x.dtype), is_bias=True)
+    out = F.bilinear(x, y, w, bias=b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+# -- control flow ------------------------------------------------------------
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First branch whose predicate holds (reference
+    control_flow.py:2565) — lowered as a nested `cond` chain, so it works
+    both eagerly and traced."""
+    from .nn import cond
+
+    if not pred_fn_pairs:
+        raise TypeError("pred_fn_pairs may not be empty")
+
+    def build(pairs):
+        (pred, fn) = pairs[0]
+        if len(pairs) == 1:
+            if default is None:
+                return fn()   # reference: last fn is the fallback
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Branch by integer index (reference control_flow.py:3684)."""
+    from .nn import cond
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+
+    def build(pairs):
+        idx, fn = pairs[0]
+        same = (branch_index == idx)
+        if len(pairs) == 1:
+            if default is None:
+                return fn()
+            return cond(same, fn, default)
+        return cond(same, fn, lambda: build(pairs[1:]))
+
+    return build(items)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference py_func_op): runs ``func`` on concrete
+    values.  Under jit this is a host callback boundary; eager it just
+    calls through."""
+    xs = [x] if isinstance(x, Tensor) else list(x)
+    res = func(*xs)
+    return res if res is not None else out
+
+
+# -- LoD legacy (descoped with reasons) -------------------------------------
+
+def _lod_stub(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"static.nn.{name} operates on LoD (variable-length) tensors, "
+            "a fluid-era representation with no 2.x tensor equivalent; "
+            "use padded tensors + masks (paddle.nn, sequence_mask) "
+            "instead")
+    fn.__name__ = name
+    fn.__qualname__ = name
+    return fn
+
+
+for _n in ("sequence_concat", "sequence_conv", "sequence_enumerate",
+           "sequence_expand", "sequence_expand_as", "sequence_first_step",
+           "sequence_last_step", "sequence_pad", "sequence_pool",
+           "sequence_reshape", "sequence_reverse", "sequence_scatter",
+           "sequence_slice", "sequence_softmax", "sequence_unpad",
+           "crf_decoding", "nce", "multi_box_head", "row_conv",
+           "sparse_embedding"):
+    globals()[_n] = _lod_stub(_n)

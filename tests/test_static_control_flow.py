@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu import static
 from paddle_tpu.static import cond, while_loop
 
 
@@ -90,3 +91,47 @@ class TestWhileLoop:
         # compiled once, data-dependent trip count
         out2 = f(paddle.to_tensor(np.int32(6)), x)
         np.testing.assert_allclose(np.asarray(out2.numpy()), [64.0])
+
+
+class TestStaticNNBuilders:
+    """fluid-style static.nn builders (reference static/nn/__init__.py)."""
+
+    def test_fc_conv_norms(self):
+        import numpy as np
+
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 6).astype(np.float32))
+        assert tuple(static.nn.fc(x, 4, activation="relu").shape) == (2, 4)
+        img = paddle.to_tensor(rs.randn(1, 3, 8, 8).astype(np.float32))
+        assert tuple(static.nn.conv2d(img, 5, 3).shape) == (1, 5, 6, 6)
+        assert tuple(static.nn.conv2d_transpose(img, 2, 3).shape) == \
+            (1, 2, 10, 10)
+        assert tuple(static.nn.batch_norm(img).shape) == (1, 3, 8, 8)
+        assert tuple(static.nn.layer_norm(img).shape) == (1, 3, 8, 8)
+        assert tuple(static.nn.group_norm(img, 3).shape) == (1, 3, 8, 8)
+        emb = static.nn.embedding(
+            paddle.to_tensor(np.array([1, 2], np.int64)), (10, 4))
+        assert tuple(emb.shape) == (2, 4)
+
+    def test_case_and_switch_case(self):
+        import numpy as np
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        r = static.nn.case([
+            (paddle.to_tensor(False), lambda: x * 0),
+            (paddle.to_tensor(True), lambda: x + 1),
+        ], default=lambda: x * 9)
+        np.testing.assert_allclose(np.asarray(r.numpy()), 2.0)
+        r2 = static.nn.switch_case(
+            paddle.to_tensor(np.int64(2)),
+            {1: lambda: x * 0, 2: lambda: x * 5},
+            default=lambda: x)
+        np.testing.assert_allclose(np.asarray(r2.numpy()), 5.0)
+
+    def test_lod_family_raises_with_reason(self):
+        import pytest as _pt
+
+        with _pt.raises(NotImplementedError, match="LoD"):
+            static.nn.sequence_pool(paddle.to_tensor([1.0]))
+        with _pt.raises(NotImplementedError, match="LoD"):
+            static.nn.nce(None, None)
