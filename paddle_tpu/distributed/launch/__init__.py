@@ -49,6 +49,20 @@ def _parse(argv: Optional[List[str]] = None):
                    help="restarts after worker failure before giving up")
     p.add_argument("--start_port", type=int,
                    default=int(os.environ.get("PADDLE_START_PORT", "6170")))
+    p.add_argument("--elastic_coordinator", type=str,
+                   default=os.environ.get("PADDLE_ELASTIC_COORDINATOR"),
+                   help="shared directory for elastic membership "
+                        "(FileCoordinator; reference: --elastic_server "
+                        "etcd url)")
+    p.add_argument("--np", type=str, default=None,
+                   help='elastic node count, "N" or "min:max" '
+                        "(with --elastic_coordinator)")
+    p.add_argument("--job_id", type=str,
+                   default=os.environ.get("PADDLE_ELASTIC_JOB_ID", "default"),
+                   help="elastic job id namespacing the coordinator")
+    p.add_argument("--host", type=str,
+                   default=os.environ.get("POD_IP"),
+                   help="this node's address for elastic membership")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -137,12 +151,88 @@ def _build_workers(args, master: str) -> List[_Worker]:
     return workers
 
 
+def _launch_elastic(args, master) -> int:
+    """Membership-driven launch loop (reference: elastic manager.watch
+    driving the launcher; fleet/elastic/manager.py:570).  Each round:
+    wait for a launchable membership, regenerate ranks, start workers,
+    then restart on membership change / ELASTIC_EXIT_CODE, exit on
+    completion or error."""
+    import socket
+
+    from ..fleet.elastic import (
+        ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus, FileCoordinator,
+        LauncherInterface)
+
+    host = args.host or socket.gethostname()
+    curr = f"{host}:{args.start_port}"
+    coord = FileCoordinator(args.elastic_coordinator)
+    manager = ElasticManager(coord, job_id=args.job_id,
+                             np=args.np or str(args.nnodes),
+                             curr_host=curr)
+
+    class _Launcher(LauncherInterface):
+        def __init__(self):
+            self.workers = []
+
+        def launch(self):
+            for w in self.workers:
+                w.start()
+
+        def watch(self):
+            alive = False
+            for w in self.workers:
+                rc = w.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    return rc
+            return None if alive else 0
+
+        def stop(self):
+            for w in self.workers:
+                w.terminate()
+
+    try:
+        while True:
+            if not manager.wait(timeout=manager.elastic_timeout * 4):
+                print("[launch] elastic: membership never became "
+                      "launchable", file=sys.stderr)
+                return 1
+            env_updates = manager.sync()
+            os.environ.update(env_updates)
+            # rebuild worker topology from the regenerated ranks
+            hosts = env_updates["PADDLE_TRAINER_ENDPOINTS"].split(",")
+            args.nnodes = len(hosts)
+            args.node_rank = int(env_updates["PADDLE_TRAINER_ID"])
+            args.ips = ",".join(h.split(":")[0] for h in hosts)
+            launcher = _Launcher()
+            launcher.workers = _build_workers(args, master)
+            manager.run(launcher)
+            status = manager.watch()
+            launcher.stop()
+            if status == ElasticStatus.COMPLETED:
+                return 0
+            if status == ElasticStatus.ERROR:
+                return 1
+            if status in (ElasticStatus.RESTART, ElasticStatus.HOLD):
+                print(f"[launch] elastic: {status}; resyncing membership",
+                      file=sys.stderr)
+                continue
+            return 0
+    finally:
+        manager.exit()
+        coord.close()
+
+
 def launch(argv: Optional[List[str]] = None) -> int:
     """Run the launcher; returns the exit code (0 = all workers OK)."""
     args = _parse(argv)
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     master = args.master or f"127.0.0.1:{_free_port()}"
+
+    if args.elastic_coordinator:
+        return _launch_elastic(args, master)
 
     restarts = 0
     while True:

@@ -63,3 +63,32 @@ class TestLaunchCLI:
                   "--help"], {})
         assert r.returncode == 0
         assert "nproc_per_node" in r.stdout
+
+
+class TestElasticLaunch:
+    """--elastic_coordinator drives launch through the ElasticManager
+    (reference: launch --elastic_server; here a FileCoordinator dir)."""
+
+    def test_single_node_elastic_completes(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        import textwrap
+
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            print("RANK", os.environ.get("PADDLE_TRAINER_ID"),
+                  "WORLD", os.environ.get("PADDLE_TRAINERS_NUM"))
+        """))
+        coord = str(tmp_path / "coord")
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--elastic_coordinator", coord,
+             "--np", "1", str(script)],
+            env=env, capture_output=True, text=True, timeout=240, cwd=repo)
+        assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
