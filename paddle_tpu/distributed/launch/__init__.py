@@ -151,7 +151,7 @@ def _build_workers(args, master: str) -> List[_Worker]:
     return workers
 
 
-def _launch_elastic(args, master) -> int:
+def _launch_elastic(args) -> int:
     """Membership-driven launch loop (reference: elastic manager.watch
     driving the launcher; fleet/elastic/manager.py:570).  Each round:
     wait for a launchable membership, regenerate ranks, start workers,
@@ -160,8 +160,7 @@ def _launch_elastic(args, master) -> int:
     import socket
 
     from ..fleet.elastic import (
-        ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus, FileCoordinator,
-        LauncherInterface)
+        ElasticManager, ElasticStatus, FileCoordinator, LauncherInterface)
 
     host = args.host or socket.gethostname()
     curr = f"{host}:{args.start_port}"
@@ -192,6 +191,18 @@ def _launch_elastic(args, master) -> int:
             for w in self.workers:
                 w.terminate()
 
+    current = {"launcher": None}
+
+    def _teardown(sig, _frame):
+        if current["launcher"] is not None:
+            current["launcher"].stop()
+        manager.exit()
+        coord.close()
+        sys.exit(128 + sig)
+
+    old_int = signal.signal(signal.SIGINT, _teardown)
+    old_term = signal.signal(signal.SIGTERM, _teardown)
+    round_idx = 0
     try:
         while True:
             if not manager.wait(timeout=manager.elastic_timeout * 4):
@@ -205,11 +216,26 @@ def _launch_elastic(args, master) -> int:
             args.nnodes = len(hosts)
             args.node_rank = int(env_updates["PADDLE_TRAINER_ID"])
             args.ips = ",".join(h.split(":")[0] for h in hosts)
+            # every node must agree on the jax.distributed coordinator:
+            # derive it from the CANONICAL rank-0 host of this round, on
+            # a port varied per round (a fresh port avoids colliding
+            # with a half-dead coordinator, like the static restart path)
+            if args.master:
+                round_master = args.master
+            else:
+                rank0 = hosts[0].split(":")[0]
+                round_master = (
+                    f"{rank0}:{args.start_port + 10000 + round_idx % 97}")
+            round_idx += 1
             launcher = _Launcher()
-            launcher.workers = _build_workers(args, master)
+            current["launcher"] = launcher
+            launcher.workers = _build_workers(args, round_master)
             manager.run(launcher)
-            status = manager.watch()
-            launcher.stop()
+            try:
+                status = manager.watch()
+            finally:
+                launcher.stop()
+                current["launcher"] = None
             if status == ElasticStatus.COMPLETED:
                 return 0
             if status == ElasticStatus.ERROR:
@@ -220,6 +246,8 @@ def _launch_elastic(args, master) -> int:
                 continue
             return 0
     finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
         manager.exit()
         coord.close()
 
@@ -232,7 +260,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
     master = args.master or f"127.0.0.1:{_free_port()}"
 
     if args.elastic_coordinator:
-        return _launch_elastic(args, master)
+        return _launch_elastic(args)
 
     restarts = 0
     while True:
