@@ -217,15 +217,20 @@ def _launch_elastic(args) -> int:
             args.node_rank = int(env_updates["PADDLE_TRAINER_ID"])
             args.ips = ",".join(h.split(":")[0] for h in hosts)
             # every node must agree on the jax.distributed coordinator:
-            # derive it from the CANONICAL rank-0 host of this round, on
-            # a port varied per round (a fresh port avoids colliding
-            # with a half-dead coordinator, like the static restart path)
+            # derive it purely from SHARED membership state — the rank-0
+            # endpoint plus a membership-epoch offset (a fresh port per
+            # membership avoids colliding with a half-dead coordinator,
+            # like the static restart path; local counters would desync
+            # nodes that joined in different rounds)
             if args.master:
                 round_master = args.master
             else:
-                rank0 = hosts[0].split(":")[0]
-                round_master = (
-                    f"{rank0}:{args.start_port + 10000 + round_idx % 97}")
+                import zlib
+
+                h0, p0 = hosts[0].rsplit(":", 1)
+                epoch = zlib.crc32(
+                    env_updates["PADDLE_TRAINER_ENDPOINTS"].encode())
+                round_master = f"{h0}:{int(p0) + 10000 + epoch % 97}"
             round_idx += 1
             launcher = _Launcher()
             current["launcher"] = launcher

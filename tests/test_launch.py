@@ -92,3 +92,53 @@ class TestElasticLaunch:
              "--np", "1", str(script)],
             env=env, capture_output=True, text=True, timeout=240, cwd=repo)
         assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+
+    @pytest.mark.slow
+    def test_two_launchers_rendezvous_via_coordinator(self, tmp_path):
+        """Two launch processes (simulated nodes) discover each other
+        through the FileCoordinator, agree on the rank-0-derived master,
+        and both complete (exercises the multi-node master derivation)."""
+        import subprocess
+        import textwrap
+
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            rank = os.environ.get("PADDLE_TRAINER_ID")
+            world = os.environ.get("PADDLE_TRAINERS_NUM")
+            master = os.environ.get("PADDLE_MASTER")
+            print("OK", rank, world, master, flush=True)
+        """))
+        coord = str(tmp_path / "coord")
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+
+        def start(port):
+            e = dict(env)
+            return subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nproc_per_node", "1", "--elastic_coordinator", coord,
+                 "--np", "2", "--host", "127.0.0.1",
+                 "--start_port", str(port), str(script)],
+                env=e, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True, cwd=repo)
+
+        a = start(6270)
+        b = start(6280)
+        try:
+            out_a, err_a = a.communicate(timeout=240)
+            out_b, err_b = b.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            a.kill(); b.kill()
+            raise
+        assert a.returncode == 0, (out_a[-800:], err_a[-1500:])
+        assert b.returncode == 0, (out_b[-800:], err_b[-1500:])
+        # both rounds agreed on ONE master derived from the rank-0 host
+        masters = set()
+        for out in (out_a, out_b):
+            for line in out.splitlines():
+                if line.startswith("OK"):
+                    masters.add(line.split()[-1])
+        assert len(masters) == 1, masters
