@@ -115,6 +115,36 @@ def _layer_params(operands):
     return ps
 
 
+_SKIP_CALL_MODULES = ("paddle_tpu", "jax", "numpy", "builtins",
+                      "functools", "itertools", "operator", "np")
+
+
+def convert_call(fn):
+    """Recursively convert plain USER functions reached from converted
+    code (reference: convert_call wrapping every call site,
+    dygraph_to_static/convert_call_func.py).  Library code (paddle_tpu /
+    jax / numpy / builtins) is never touched — it has no tensor-dependent
+    python control flow by construction."""
+    try:
+        import inspect
+
+        if inspect.isfunction(fn) or inspect.ismethod(fn):
+            target = fn.__func__ if inspect.ismethod(fn) else fn
+            mod = getattr(target, "__module__", "") or ""
+            if getattr(target, _CONVERTED_MARK, False):
+                return fn
+            if mod.split(".")[0] in _SKIP_CALL_MODULES:
+                return fn
+            return convert_function(fn)
+    except Exception:
+        pass
+    return fn
+
+
+# short alias used by generated code at every call site
+cvt = convert_call
+
+
 def convert_ifelse(pred, true_fn, false_fn, operands=()):
     """``if pred: ... else: ...`` with assigned-name outputs."""
     from ..static.nn import cond as static_cond
@@ -356,6 +386,28 @@ def _unpack_assign(out_names: List[str], value: ast.expr) -> ast.stmt:
     return ast.Assign(targets=[tgt], value=value)
 
 
+class _CallSiteWrapper(ast.NodeTransformer):
+    """foo(args) -> _jst.cvt(foo)(args) for plain-name/attribute callees,
+    so user helper functions get converted recursively (reference
+    convert_call).  Generated _jst.* calls are left alone."""
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and not f.id.startswith("__jst_"):
+            pass
+        elif isinstance(f, ast.Attribute):
+            root = f
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "_jst":
+                return node
+        else:
+            return node
+        node.func = ast.Call(func=_jst_attr("cvt"), args=[f], keywords=[])
+        return node
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.changed = False
@@ -518,7 +570,11 @@ def convert_function(fn):
     tr = _ControlFlowTransformer()
     fdef.body = [x for stmt in fdef.body
                  for x in _as_list(tr.visit(stmt))]
-    if not tr.changed:
+    # call-site wrapping lets helpers reached from here convert too
+    # (reference convert_call); only worth the indirection when this
+    # function itself converts, or when it might CALL converting code
+    _CallSiteWrapper().visit(fdef)
+    if not tr.changed and not _has_user_calls(fdef):
         setattr(fn, _CONVERTED_MARK, True)
         return fn if bound_self is None else fn.__get__(bound_self)
     ast.fix_missing_locations(tree)
@@ -542,6 +598,16 @@ def convert_function(fn):
     functools.update_wrapper(new_fn, fn)
     setattr(new_fn, _CONVERTED_MARK, True)
     return new_fn if bound_self is None else new_fn.__get__(bound_self)
+
+
+def _has_user_calls(fdef) -> bool:
+    """Does the (wrapped) function contain any _jst.cvt call sites?"""
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Call) and \
+                isinstance(n.func.func, ast.Attribute) and \
+                n.func.func.attr == "cvt":
+            return True
+    return False
 
 
 def _as_list(v):

@@ -281,3 +281,47 @@ def test_converted_function_sees_rebound_globals():
         np.testing.assert_allclose(g(x).numpy(), [2.0])
     finally:
         _FLAG = 1.0
+
+
+def _helper_branch(x):
+    # helper with tensor-dependent control flow, NOT decorated itself
+    if x.sum() > 0:
+        return x * 2
+    return x - 1
+
+
+def caller_net(x):
+    h = _helper_branch(x)     # must be converted via convert_call
+    return h + 10
+
+
+def test_convert_call_recurses_into_helpers():
+    """Reference convert_call semantics: helpers reached from converted
+    code convert too... but _helper_branch uses `return` inside the if,
+    which bails ITS conversion — it still must not break the call."""
+    g = convert_function(caller_net)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(g(x).numpy(), [12.0])
+
+
+def _helper_assign(x):
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = x - 1
+    return y
+
+
+def deep_net(x):
+    return _helper_assign(x) + 100
+
+
+def test_convert_call_traced_helper_branches():
+    g = convert_function(deep_net)
+    step = paddle.jit.to_static(g)
+    pos = paddle.to_tensor(np.array([1.0], np.float32))
+    neg = paddle.to_tensor(np.array([-2.0], np.float32))
+    np.testing.assert_allclose(step(pos).numpy(), [102.0])
+    # SAME compiled program takes the other branch (helper converted)
+    np.testing.assert_allclose(step(neg).numpy(), [97.0])
+    assert len(step.program_cache) == 1
