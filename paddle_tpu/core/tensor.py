@@ -51,16 +51,61 @@ class SymbolicDim(int):
         of = o.feeds if isinstance(o, SymbolicDim) else frozenset()
         return SymbolicDim(v, self.feeds | of)
 
-    # arithmetic keeps the taint so `x.shape[0] * n` style attrs are caught
-    def __add__(self, o): return self._mix(int(self) + int(o), o)
-    def __radd__(self, o): return self._mix(int(o) + int(self), o)
-    def __sub__(self, o): return self._mix(int(self) - int(o), o)
-    def __rsub__(self, o): return self._mix(int(o) - int(self), o)
-    def __mul__(self, o): return self._mix(int(self) * int(o), o)
-    def __rmul__(self, o): return self._mix(int(o) * int(self), o)
-    def __floordiv__(self, o): return self._mix(int(self) // int(o), o)
-    def __rfloordiv__(self, o): return self._mix(int(o) // int(self), o)
-    def __mod__(self, o): return self._mix(int(self) % int(o), o)
+    # arithmetic keeps the taint so `x.shape[0] * n` style attrs are caught;
+    # non-int operands (floats etc.) fall back to ordinary numeric semantics
+    # — the taint is lost but the value stays correct (0.5 * dim must not
+    # become SymbolicDim(0)).
+    @staticmethod
+    def _intlike(o):
+        import numpy as _np
+        return (isinstance(o, (int, _np.integer))
+                and not isinstance(o, bool))
+
+    def __add__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(self) + int(o), o)
+
+    def __radd__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(o) + int(self), o)
+
+    def __sub__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(self) - int(o), o)
+
+    def __rsub__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(o) - int(self), o)
+
+    def __mul__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(self) * int(o), o)
+
+    def __rmul__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(o) * int(self), o)
+
+    def __floordiv__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(self) // int(o), o)
+
+    def __rfloordiv__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(o) // int(self), o)
+
+    def __mod__(self, o):
+        if not self._intlike(o):
+            return NotImplemented
+        return self._mix(int(self) % int(o), o)
+
     def __neg__(self): return SymbolicDim(-int(self), self.feeds)
 
     def __repr__(self):

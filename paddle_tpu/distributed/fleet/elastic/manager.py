@@ -251,11 +251,20 @@ class ElasticManager:
                 self._lease.refresh()
             except Exception:
                 # lease lost: re-register so a transient coordinator blip
-                # does not evict a healthy node (reference :266)
+                # does not evict a healthy node (reference :266) — but only
+                # while a slot is free.  If a replacement already filled the
+                # membership, barging back in would make it over-capacity
+                # and unlaunchable for everyone; keep ticking instead and
+                # take the next vacancy.
                 try:
-                    self._lease = self.coord.lease(self.lease_ttl)
-                    self.coord.put(self.node_prefix + self.curr_host,
-                                   self.curr_host, lease=self._lease)
+                    others = [h for h in self._current_hosts()
+                              if h != self.curr_host]
+                    cap = (self.np if self.elastic_level ==
+                           ElasticLevel.FAULT_TOLERANCE else self.max_np)
+                    if len(others) < cap:
+                        self._lease = self.coord.lease(self.lease_ttl)
+                        self.coord.put(self.node_prefix + self.curr_host,
+                                       self.curr_host, lease=self._lease)
                 except Exception:
                     pass
 
@@ -318,13 +327,20 @@ class ElasticManager:
         assert not homeless
         return slots
 
-    def sync(self) -> Dict[str, str]:
+    def sync(self) -> Optional[Dict[str, str]]:
         """Adopt the current membership: compute the new rank table,
         publish it, and return this host's launch env (reference
-        _update_hosts :537)."""
+        _update_hosts :537).  Returns None — BEFORE publishing anything —
+        when this host fell out of the membership (lease lapse during
+        churn): the caller must hold; the heartbeat loop re-registers as
+        soon as a slot is free."""
         if not self.hosts:
             self._match()
         new_order = self._regen_ranks()
+        if self.curr_host not in new_order:
+            self.hosts = []
+            self.need_sync = True
+            return None
         scale = len(new_order) - len(self.trainer_hosts) \
             if self.trainer_hosts else 0
         self.trainer_hosts = new_order

@@ -317,18 +317,23 @@ class DGCMomentumOptimizer:
 
 
 class FP16AllReduceOptimizer:
-    """Compress f32 gradients to fp16 across the data-parallel all-reduce
+    """Match the NUMERICS of the reference's fp16 all-reduce
     (reference: meta_optimizers/fp16_allreduce_optimizer.py:20 — cast
     fp32→fp16 before c_allreduce_sum, cast back after).
 
-    TPU-native shape: under jit the DP all-reduce is the XLA psum that
-    GSPMD inserts over the grad, so "compress the wire" = make the tensor
-    crossing the collective fp16.  This wrapper applies the same
-    cast-down/cast-up pair around the gradient before the inner update;
-    inside a compiled train step XLA places the psum between the two casts
-    (the fp16 tensor is what rides ICI), and in eager multi-controller use
-    the quantization semantics match the reference exactly.  Gradients
-    already in fp16/bf16 are left alone, like the reference's dtype filter.
+    Honest scope note: the reference's goal is wire compression — fp16
+    rides NCCL.  Under this framework the DP reduce is the psum GSPMD
+    inserts during backward, which has already run (in f32) by the time
+    ``.grad`` is readable here, and XLA cannot legally hoist that psum
+    across a value-changing f32→f16→f32 cast chain.  So this wrapper
+    reproduces the reference's *quantization granularity* (the optimizer
+    sees fp16-precision grads; not bitwise-equal — the reference sums
+    already-quantized fp16 shards, here the f32 sum is quantized once),
+    but the ICI wire traffic stays f32.  To actually compress the wire, train in
+    AMP-O2 (bf16 params/grads end-to-end) — the collective then natively
+    carries 16-bit data, which is the TPU-idiomatic equivalent.
+    Gradients already in fp16/bf16 are left alone, like the reference's
+    dtype filter.
     """
 
     def __init__(self, inner):

@@ -210,6 +210,12 @@ def _launch_elastic(args) -> int:
                       "launchable", file=sys.stderr)
                 return 1
             env_updates = manager.sync()
+            if env_updates is None:
+                # this host fell out of the regenerated membership (lease
+                # lapse during churn): hold as a standby — the heartbeat
+                # re-registers when a slot frees up
+                time.sleep(max(manager.lease_ttl / 3.0, 0.05))
+                continue
             os.environ.update(env_updates)
             # rebuild worker topology from the regenerated ranks
             hosts = env_updates["PADDLE_TRAINER_ENDPOINTS"].split(",")

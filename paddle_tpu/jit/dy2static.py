@@ -177,12 +177,24 @@ def convert_while(cond_fn, body_fn, init_vars):
     from ..static.nn import while_loop
 
     init_vars = list(init_vars)
-    traced = any(_is_traced(v) for v in init_vars) or \
-        _is_traced(cond_fn(*init_vars))
-    if traced:
-        init_vars = _promote_loop_vars(init_vars)
-    out = while_loop(cond_fn, body_fn, init_vars)
-    return tuple(out)
+    if any(_is_traced(v) for v in init_vars):
+        return tuple(while_loop(cond_fn, body_fn,
+                                _promote_loop_vars(init_vars)))
+    # Concrete init vars: evaluate the condition ONCE and reuse it as the
+    # loop's first test, so conditions with side effects (iterator
+    # consumption, counters) run exactly as many times as plain python
+    # would run them.  The condition may still come back traced when it
+    # reads a traced closure var — promote and lower in that case.
+    test = cond_fn(*init_vars)
+    if _is_traced(test):
+        return tuple(while_loop(cond_fn, body_fn,
+                                _promote_loop_vars(init_vars)))
+    vars_ = init_vars
+    while bool(test.item() if isinstance(test, Tensor) else test):
+        res = body_fn(*vars_)
+        vars_ = list(res) if isinstance(res, (tuple, list)) else [res]
+        test = cond_fn(*vars_)
+    return tuple(vars_)
 
 
 def convert_range_loop(start, stop, step, body_fn, init_vars):
