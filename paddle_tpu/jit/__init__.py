@@ -19,6 +19,30 @@ from ..core.tensor import Tensor, to_tensor
 from ..core import dtype as dtype_mod
 from .trace import CompiledProgram, _flatten_io, spec_of
 
+# tracer-leak errors: a Tensor whose value exists only inside the trace
+# was forced to a concrete python value (bool/int/array) by unconverted
+# control flow — mapped back to user source via dy2static.map_trace_error
+_TRACER_LEAK_ERRORS = tuple(
+    e for e in (getattr(jax.errors, n, None)
+                for n in ("TracerBoolConversionError",
+                          "TracerArrayConversionError",
+                          "TracerIntegerConversionError",
+                          "ConcretizationTypeError"))
+    if e is not None)
+
+
+def _build_mapped(prog, leaves):
+    """prog.build with tracer-leak errors mapped back to user source."""
+    try:
+        prog.build(leaves)
+    except _TRACER_LEAK_ERRORS as e:
+        from .dy2static import map_trace_error
+
+        mapped = map_trace_error(e)
+        if mapped is not None:
+            raise mapped from e
+        raise
+
 
 class InputSpec:
     """Declarative input signature (reference: paddle.static.InputSpec)."""
@@ -102,7 +126,7 @@ class StaticFunction:
         if prog is None:
             prog = CompiledProgram(self._fn, args_tree, kwargs_tree,
                                    donate=self._donate)
-            prog.build(leaves)
+            _build_mapped(prog, leaves)
             self._programs[key] = prog
         return prog(leaves)
 
@@ -124,7 +148,7 @@ class StaticFunction:
         if prog is None:
             prog = CompiledProgram(self._fn, args_tree, kwargs_tree,
                                    donate=self._donate)
-            prog.build(leaves)
+            _build_mapped(prog, leaves)
             self._programs[key] = prog
         return prog
 

@@ -17,10 +17,17 @@ pipeline, but with the same variable-capture contract):
 - possibly-unbound names are captured through ``ld`` (a try/except
   closure read) and flow as ``UndefinedVar`` sentinels that raise a clear
   message on first real use;
-- statements containing ``return``/``break``/``continue``/``global``/
-  ``nonlocal``/``del`` at conversion scope are left untouched: python
-  semantics are preserved for concrete predicates, and a traced-tensor
-  predicate keeps today's explicit error.
+- ``return`` inside control flow is rewritten into a flag + value pair
+  with guarded tails (reference return_transformer.py:136), ``break``/
+  ``continue`` into loop flags folded into the loop condition (reference
+  break_continue_transformer.py:89), and ``and``/``or``/``not`` into
+  short-circuit-preserving converters that lower to logical ops on traced
+  tensors (reference logical_transformer.py);
+- statements that still cannot be converted (``yield``, ``global``,
+  attribute stores inside branches, ...) are left untouched AND recorded:
+  when a traced tensor later leaks into one, the error names the
+  construct and the user's source line (reference
+  dygraph_to_static/error.py).
 """
 from __future__ import annotations
 
@@ -36,7 +43,85 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 
 __all__ = ["convert_function", "convert_ifelse", "convert_while",
-           "convert_range_loop", "ld", "UndefinedVar"]
+           "convert_range_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "ld",
+           "UndefinedVar", "Dy2StaticError", "map_trace_error"]
+
+
+class Dy2StaticError(RuntimeError):
+    """A python construct could not be (or was not) converted to static
+    control flow, and the failure is mapped back to user source
+    (reference: dygraph_to_static/error.py, origin_info.py)."""
+
+
+# conversion-time records of constructs the transformer deliberately left
+# as plain python: {file, line, end, construct, reason}.  Consulted when a
+# tracer leaks, to tell the user WHICH statement was the wall.
+_BAIL_RECORDS: List[dict] = []
+_BAIL_KEYS: set = set()
+_MAX_BAIL_RECORDS = 512
+
+
+def _record_bail(filename: str, node: ast.stmt, construct: str, reason: str):
+    key = (filename, getattr(node, "lineno", 0), construct)
+    if key in _BAIL_KEYS:
+        return
+    if len(_BAIL_RECORDS) >= _MAX_BAIL_RECORDS:
+        dropped = _BAIL_RECORDS[:_MAX_BAIL_RECORDS // 2]
+        del _BAIL_RECORDS[:_MAX_BAIL_RECORDS // 2]
+        for r in dropped:
+            _BAIL_KEYS.discard((r["file"], r["line"], r["construct"]))
+    _BAIL_KEYS.add(key)
+    _BAIL_RECORDS.append({
+        "file": filename,
+        "line": getattr(node, "lineno", 0),
+        "end": getattr(node, "end_lineno", getattr(node, "lineno", 0)),
+        "construct": construct,
+        "reason": reason,
+    })
+
+
+def map_trace_error(exc):
+    """Build a Dy2StaticError pointing at the user statement where a
+    traced Tensor leaked into unconverted python control flow.  Returns
+    None when no user frame can be identified (caller should re-raise the
+    original)."""
+    import traceback
+
+    frames = traceback.extract_tb(exc.__traceback__)
+    user = None
+    for fr in frames:
+        f = fr.filename
+        if ("/paddle_tpu/" in f or "/jax/" in f or "/site-packages/" in f
+                or f.startswith("<")):
+            continue
+        user = fr   # keep the deepest user frame
+    if user is None:
+        return None
+    lines = [
+        "tensor-dependent python control flow could not be compiled.",
+        f"  at {user.filename}:{user.lineno}",
+    ]
+    if user.line:
+        lines.append(f"    {user.line.strip()}")
+    hits = [r for r in _BAIL_RECORDS
+            if r["file"] == user.filename
+            and r["line"] <= user.lineno <= r["end"]]
+    for r in hits[-3:]:
+        lines.append(
+            f"  the `{r['construct']}` at {r['file']}:{r['line']} was left "
+            f"as plain python because {r['reason']}; a traced Tensor "
+            "reached it, which requires static conversion")
+    if not hits:
+        lines.append(
+            "  a Tensor whose value is only known at run time was used "
+            "where python needs a concrete bool/int (if/while/assert/"
+            "index). Rewrite with paddle.static.nn.cond / while_loop, or "
+            "move the data-dependent branch out of the @to_static "
+            "function.")
+    lines.append(f"  (original error: {type(exc).__name__}: "
+                 f"{str(exc).splitlines()[0] if str(exc) else ''})")
+    return Dy2StaticError("\n".join(lines))
 
 
 # ---------------------------------------------------------------------------
@@ -145,19 +230,301 @@ def convert_call(fn):
 cvt = convert_call
 
 
-def convert_ifelse(pred, true_fn, false_fn, operands=()):
-    """``if pred: ... else: ...`` with assigned-name outputs."""
+def _is_traced_val(v):
+    if isinstance(v, Tensor):
+        v = v._value()
+    return isinstance(v, jax.core.Tracer)
+
+
+def _truthy(v):
+    if isinstance(v, Tensor):
+        return bool(v.item())
+    return bool(v)
+
+
+def convert_logical_and(x_fn, y_fn):
+    """``x and y`` (reference logical_transformer.py convert_logical_and).
+    Concrete x keeps python's exact short-circuit + value semantics;
+    traced x evaluates both sides and lowers to logical_and."""
+    x = x_fn()
+    if _is_traced_val(x):
+        y = y_fn()
+        return _logical_binop(jnp.logical_and, x, y)
+    if not _truthy(x):
+        return x
+    return y_fn()
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _is_traced_val(x):
+        y = y_fn()
+        return _logical_binop(jnp.logical_or, x, y)
+    if _truthy(x):
+        return x
+    return y_fn()
+
+
+def convert_logical_not(x):
+    if _is_traced_val(x):
+        arr = x._value() if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor._wrap(jnp.logical_not(arr))
+    return not x
+
+
+def _logical_binop(op, x, y):
+    xa = x._value() if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y._value() if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor._wrap(op(xa, ya))
+
+
+def ret_value(flag, val):
+    """Final return of a converted function that has a fall-through path
+    (not every path returns): python semantics are `val if returned else
+    None`.  A traced flag means the function would return a tensor on
+    some runtime paths and None on others — not representable in one
+    compiled program; raise an actionable error instead of silently
+    returning a placeholder."""
+    if _is_traced_val(flag):
+        raise Dy2StaticError(
+            "this function returns a value on some paths but falls off "
+            "the end (implicit `return None`) on others, and the choice "
+            "depends on a traced Tensor; a compiled program needs one "
+            "return structure — add an explicit `return` to the "
+            "fall-through path")
+    return val if _truthy(flag) else None
+
+
+# generated flag/value variables (return flags, break/continue flags, loop
+# indices) — the one name family for which a branch that does not bind the
+# variable may be filled with a typed placeholder: reads are always
+# guarded by the paired flag, so the placeholder value is never observed.
+_GEN_PREFIX = "__jstf_"
+
+
+def convert_ifelse(pred, true_fn, false_fn, operands=(), names=None,
+                   guard=False):
+    """``if pred: ... else: ...`` with assigned-name outputs.
+
+    Concrete pred: run the taken branch as plain python.  Traced pred:
+    probe both branches abstractly, unify their outputs per assigned name
+    (placeholder zeros for generated flag/value vars missing on one side,
+    dtype promotion for scalars, pass-through for equal non-tensor
+    constants, a NAMED error for user vars bound in only one branch),
+    then lower to lax.cond via static.nn.cond."""
     from ..static.nn import cond as static_cond
 
     p = pred._value() if isinstance(pred, Tensor) else pred
-    if isinstance(p, jax.core.Tracer):
+    if not isinstance(p, jax.core.Tracer):
+        taken = true_fn if bool(
+            pred.item() if isinstance(pred, Tensor) else pred) else false_fn
+        out = taken(*operands)
+        return out if isinstance(out, tuple) else (out,)
+
+    try:
+        # note: each branch runs twice at COMPILE time (abstract probe +
+        # the real trace under static_cond) — python-visible side effects
+        # in branches duplicate, same caveat as the reference's multi-pass
+        # tracing.  Probe failures fall back to the direct lowering so
+        # the real trace surfaces the error with full context.
+        t_raw = _probe_branch(true_fn, operands)
+        f_raw = _probe_branch(false_fn, operands)
+    except Dy2StaticError:
+        raise
+    except Exception:
         out = static_cond(pred, true_fn, false_fn, operands,
                           params=_layer_params(operands))
         return out if isinstance(out, tuple) else (out,)
-    taken = true_fn if bool(
-        pred.item() if isinstance(pred, Tensor) else pred) else false_fn
-    out = taken(*operands)
-    return out if isinstance(out, tuple) else (out,)
+    n = len(t_raw)
+    names = list(names) if names is not None else [f"<out {i}>"
+                                                  for i in range(n)]
+    plans = [_unify_slot(t_raw[i], f_raw[i], names[i], guard)
+             for i in range(n)]
+    tensor_ix = [i for i, pl in enumerate(plans) if pl[0] == "tree"]
+
+    def _wrap(fn):
+        def g(*ops):
+            out = fn(*ops)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            res = []
+            for i in tensor_ix:
+                _, treedef, leaf_specs = plans[i]
+                v = outs[i]
+                if _is_missing(v):
+                    leaves = [jnp.zeros(sh, dt) for sh, dt in leaf_specs]
+                else:
+                    leaves = jax.tree_util.tree_leaves(
+                        v, is_leaf=_is_leaf_obj)
+                    leaves = [
+                        _leaf_array(lv).astype(dt)
+                        for lv, (sh, dt) in zip(leaves, leaf_specs)]
+                    leaves = [jnp.broadcast_to(a, sh)
+                              for a, (sh, dt) in zip(leaves, leaf_specs)]
+                res.extend(Tensor._wrap(a) for a in leaves)
+            return tuple(res)
+        return g
+
+    const_out = {i: pl[1] for i, pl in enumerate(plans) if pl[0] == "const"}
+    if tensor_ix:
+        outs = static_cond(pred, _wrap(true_fn), _wrap(false_fn), operands,
+                           params=_layer_params(operands))
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+    else:
+        outs = []
+    # reassemble per-name values (unflatten pytree slots)
+    full = []
+    k = 0
+    for i in range(n):
+        if plans[i][0] == "tree":
+            _, treedef, leaf_specs = plans[i]
+            nleaf = len(leaf_specs)
+            full.append(jax.tree_util.tree_unflatten(
+                treedef, outs[k:k + nleaf]))
+            k += nleaf
+        else:
+            full.append(const_out[i])
+    return tuple(full)
+
+
+def _is_missing(v):
+    return v is None or isinstance(v, UndefinedVar)
+
+
+def _is_leaf_obj(v):
+    # Tensors are opaque to jax pytrees already, but be explicit so a
+    # future pytree registration cannot change flattening here
+    return isinstance(v, Tensor)
+
+
+def _leaf_array(v):
+    return v._value() if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def _probe_branch(fn, operands):
+    """Run a branch under eval_shape and capture its RAW python outputs
+    (Tensors wrap abstract tracers — shape/dtype readable, values not)."""
+    from ..core import autograd
+
+    cap = {}
+
+    def f(*arrs):
+        it = iter(arrs)
+        full = [Tensor._wrap(next(it)) if isinstance(o, Tensor) else o
+                for o in operands]
+        with autograd.no_grad():
+            out = fn(*full)
+        cap["outs"] = tuple(out) if isinstance(out, (tuple, list)) \
+            else (out,)
+        return jnp.zeros(())
+
+    jax.eval_shape(
+        f, *[o._value() for o in operands if isinstance(o, Tensor)])
+    return cap["outs"]
+
+
+def _unify_slot(t, f, name, guard=False):
+    """Decide how one assigned name flows through a traced cond.
+
+    Returns ("tree", treedef, [(shape, dtype), ...]) for values carried
+    through lax.cond, or ("const", value) for values kept outside it.
+    ``guard`` marks a return-flag tail guard: every variable first
+    assigned there is dead on the flag-set path (the function returns
+    immediately after), so missing-side placeholders are always safe."""
+    t_missing, f_missing = _is_missing(t), _is_missing(f)
+    if t_missing and f_missing:
+        return ("const", t if t is not None else f)
+    if t_missing or f_missing:
+        present = f if t_missing else t
+        leaves, treedef = jax.tree_util.tree_flatten(
+            present, is_leaf=_is_leaf_obj)
+        specs = []
+        for lv in leaves:
+            if not _arrayable(lv):
+                if guard:
+                    # dead on the missing path — carry nothing, hand the
+                    # concrete object through unchanged
+                    return ("const", present)
+                raise Dy2StaticError(
+                    f"variable '{name}' is bound to a non-tensor value "
+                    f"({type(lv).__name__}) in one branch of a converted "
+                    "`if` over a traced Tensor and left unbound in the "
+                    "other; both branches must bind it")
+            arr_sh, arr_dt = _aval_of(lv)
+            specs.append((arr_sh, arr_dt))
+        if not guard and not name.startswith(_GEN_PREFIX):
+            raise Dy2StaticError(
+                f"variable '{name}' is assigned in only one branch of an "
+                "`if` whose condition is a traced Tensor; under static "
+                "conversion both branches must bind it — assign a "
+                "default before the `if`")
+        return ("tree", treedef, specs)
+    t_leaves, t_def = jax.tree_util.tree_flatten(t, is_leaf=_is_leaf_obj)
+    f_leaves, f_def = jax.tree_util.tree_flatten(f, is_leaf=_is_leaf_obj)
+    if t_def != f_def:
+        raise Dy2StaticError(
+            f"variable '{name}' has mismatched structures across the two "
+            f"branches of a converted `if` ({t_def} vs {f_def}); both "
+            "branches must produce the same nesting of values")
+    if all(not _arrayable(lv) for lv in t_leaves + f_leaves):
+        # plain python objects on both sides (strings, layers, ...):
+        # identical values pass through, different values cannot be
+        # selected at run time
+        if _const_equal(t, f):
+            return ("const", t)
+        raise Dy2StaticError(
+            f"variable '{name}' is bound to different non-tensor python "
+            f"values in the two branches of a converted `if` "
+            f"({t!r} vs {f!r}); a traced condition can only select "
+            "tensor values")
+    specs = []
+    for name_i, (tl, fl) in enumerate(zip(t_leaves, f_leaves)):
+        if not (_arrayable(tl) and _arrayable(fl)):
+            raise Dy2StaticError(
+                f"variable '{name}' mixes tensor and non-tensor values "
+                "across the branches of a converted `if`; both branches "
+                "must produce tensors (or equal python constants)")
+        tsh, tdt = _aval_of(tl)
+        fsh, fdt = _aval_of(fl)
+        sh = _broadcast_shapes(tsh, fsh, name)
+        specs.append((sh, jnp.promote_types(tdt, fdt)))
+    return ("tree", t_def, specs)
+
+
+def _arrayable(v):
+    return isinstance(v, (Tensor, jax.Array)) or (
+        isinstance(v, (bool, int, float)) or _np_scalar(v))
+
+
+def _np_scalar(v):
+    import numpy as _np
+    return isinstance(v, (_np.ndarray, _np.generic))
+
+
+def _aval_of(v):
+    if isinstance(v, Tensor):
+        a = v._value()
+        return tuple(a.shape), a.dtype
+    a = jnp.asarray(v) if not isinstance(v, jax.Array) else v
+    return tuple(a.shape), a.dtype
+
+
+def _broadcast_shapes(a, b, name):
+    try:
+        import numpy as _np
+        return tuple(_np.broadcast_shapes(a, b))
+    except ValueError:
+        raise Dy2StaticError(
+            f"variable '{name}' has incompatible shapes across the two "
+            f"branches of a converted `if` ({a} vs {b})")
+
+
+def _const_equal(a, b):
+    if a is b:
+        return True
+    try:
+        return bool(a == b)
+    except Exception:
+        return False
 
 
 def _promote_loop_vars(vars_):
@@ -172,47 +539,115 @@ def _promote_loop_vars(vars_):
     return out
 
 
-def convert_while(cond_fn, body_fn, init_vars):
+def _check_loop_carry(names, vars_, probe):
+    """A tensor-dependent loop carries a fixed structure: a var that is
+    None/unbound at entry but becomes a Tensor inside the body would be
+    silently dropped by lax.while_loop — catch it with a named error
+    instead.  `probe` abstractly evaluates the body; probe failures are
+    ignored (the real trace will surface them with context)."""
+    if names is None:
+        return
+    missing = [i for i, v in enumerate(vars_) if _is_missing(v)]
+    if not missing:
+        return
+    try:
+        outs = probe()
+    except Exception:
+        return
+    for i in missing:
+        if i < len(outs) and isinstance(outs[i], Tensor):
+            nm = names[i]
+            if nm.startswith(_GEN_PREFIX + "val"):
+                raise Dy2StaticError(
+                    "early `return` inside a loop whose trip count "
+                    "depends on a traced Tensor is not supported: the "
+                    "return value has no defined type before the first "
+                    "iteration. Assign the result to a variable "
+                    "initialized before the loop and return it after.")
+            raise Dy2StaticError(
+                f"loop variable '{nm}' enters a tensor-dependent loop "
+                "unbound (or None) but is assigned a Tensor inside the "
+                "body; initialize it with a correctly-shaped tensor "
+                "before the loop so the compiled loop can carry it")
+
+
+def _probe_body(body_fn, vars_):
+    cap = {}
+
+    def f(*arrs):
+        it = iter(arrs)
+        full = [Tensor._wrap(next(it)) if isinstance(o, Tensor) else o
+                for o in vars_]
+        from ..core import autograd
+        with autograd.no_grad():
+            out = body_fn(*full)
+        cap["outs"] = tuple(out) if isinstance(out, (tuple, list)) \
+            else (out,)
+        return jnp.zeros(())
+
+    jax.eval_shape(
+        f, *[o._value() for o in vars_ if isinstance(o, Tensor)])
+    return cap["outs"]
+
+
+def convert_while(cond_fn, body_fn, init_vars, names=None):
     """``while cond: body`` over the body's assigned names."""
     from ..static.nn import while_loop
 
-    init_vars = list(init_vars)
-    if any(_is_traced(v) for v in init_vars):
-        return tuple(while_loop(cond_fn, body_fn,
-                                _promote_loop_vars(init_vars)))
-    # Concrete init vars: evaluate the condition ONCE and reuse it as the
-    # loop's first test, so conditions with side effects (iterator
-    # consumption, counters) run exactly as many times as plain python
-    # would run them.  The condition may still come back traced when it
-    # reads a traced closure var — promote and lower in that case.
-    test = cond_fn(*init_vars)
-    if _is_traced(test):
-        return tuple(while_loop(cond_fn, body_fn,
-                                _promote_loop_vars(init_vars)))
-    vars_ = init_vars
-    while bool(test.item() if isinstance(test, Tensor) else test):
+    def _lower(vars_):
+        vars_ = _promote_loop_vars(vars_)
+        _check_loop_carry(names, vars_, lambda: _probe_body(body_fn, vars_))
+        return tuple(while_loop(cond_fn, body_fn, vars_))
+
+    vars_ = list(init_vars)
+    if any(_is_traced(v) for v in vars_):
+        return _lower(vars_)
+    # Concrete state: run the python loop, evaluating the condition
+    # exactly once per iteration (python's count — conditions with side
+    # effects behave identically).  The CONDITION decides when to lower:
+    # the moment it comes back traced (e.g. a break flag set inside a
+    # tensor-dependent branch), hand the CURRENT state to the compiled
+    # while_loop — completed iterations stay applied, lax runs the rest.
+    # Body vars turning traced while the condition stays concrete is
+    # plain eager-style unrolling and needs no lowering.
+    while True:
+        test = cond_fn(*vars_)
+        if _is_traced(test):
+            return _lower(vars_)
+        if not _truthy(test):
+            return tuple(vars_)
         res = body_fn(*vars_)
         vars_ = list(res) if isinstance(res, (tuple, list)) else [res]
-        test = cond_fn(*vars_)
-    return tuple(vars_)
 
 
-def convert_range_loop(start, stop, step, body_fn, init_vars):
+def convert_range_loop(start, stop, step, body_fn, init_vars, names=None,
+                       target_init=None):
     """``for i in range(start, stop, step): body`` — body_fn(i, *vars) ->
-    vars.  Concrete bounds run the plain python loop (still unrolls under
-    an outer trace, matching previous behavior); traced bounds lower to a
-    while_loop with the index as a carried Tensor."""
+    vars.  Returns ``(final_target, *vars)``: python leaves the loop
+    target bound to the last iterated value, and code after the loop may
+    read it.  Concrete bounds run the plain python loop (still unrolls
+    under an outer trace); traced bounds lower to a while_loop with the
+    index as a carried Tensor.  Body reassignment of the target is local
+    to the iteration (it does not alter the final value) — same contract
+    as the carried-index lowering."""
     from ..static.nn import while_loop
 
     bounds = [start, stop, step]
+    if any(_is_traced(b) for b in bounds):
+        _check_loop_carry(
+            names, list(init_vars),
+            lambda: _probe_body(lambda *vs: body_fn(start, *vs),
+                                list(init_vars)))
     if not any(_is_traced(b) for b in bounds):
         vars_ = tuple(init_vars)
+        tgt = target_init
         s0 = int(start.item() if isinstance(start, Tensor) else start)
         s1 = int(stop.item() if isinstance(stop, Tensor) else stop)
         st = int(step.item() if isinstance(step, Tensor) else step)
         for i in range(s0, s1, st):
+            tgt = i
             vars_ = body_fn(i, *vars_)
-        return tuple(vars_)
+        return (tgt,) + tuple(vars_)
 
     init = _promote_loop_vars([start] + list(init_vars))
     step_c = step if isinstance(step, Tensor) else Tensor._wrap(
@@ -235,7 +670,26 @@ def convert_range_loop(start, stop, step, body_fn, init_vars):
         return (nxt,) + tuple(new)
 
     out = while_loop(_cond, _body, init)
-    return tuple(out[1:])
+    # the carried index overshoots by one step; python's final target is
+    # the last IN-range value — select the pre-loop binding when the loop
+    # ran zero times (if that binding is not a number, the overshoot-
+    # corrected value stands in: python would have left the name unbound)
+    over = out[0]
+    sa = step_c._value()
+    st_a = start._value() if isinstance(start, Tensor) else jnp.asarray(start)
+    sp_a = stop_c._value()
+    ran = jnp.where(sa > 0, st_a < sp_a, st_a > sp_a)
+    last = (over._value() if isinstance(over, Tensor) else
+            jnp.asarray(over)) - sa
+    if target_init is not None and not isinstance(target_init, UndefinedVar):
+        try:
+            ti = jnp.asarray(
+                target_init._value() if isinstance(target_init, Tensor)
+                else target_init).astype(last.dtype)
+            last = jnp.where(ran, last, ti)
+        except Exception:
+            pass
+    return (Tensor._wrap(last),) + tuple(out[1:])
 
 
 # ---------------------------------------------------------------------------
@@ -287,19 +741,34 @@ def _nonname_store(n) -> bool:
     return any(bad(t) for t in tgts)
 
 
-def _has_bail(stmts) -> bool:
+_BAIL_KEYWORD = {
+    ast.Return: "return", ast.Break: "break", ast.Continue: "continue",
+    ast.Global: "global", ast.Nonlocal: "nonlocal", ast.Delete: "del",
+    ast.Yield: "yield", ast.YieldFrom: "yield from", ast.Await: "await",
+}
+
+
+def _bail_reason(stmts) -> Optional[str]:
+    """Why this statement region cannot become a branch/loop-body
+    function — None when it can."""
     for s in stmts:
         for n in _walk_stmt(s):
             if _nonname_store(n):
-                return True
+                return ("it assigns into an attribute/subscript (object "
+                        "mutation cannot cross a compiled branch)")
             if isinstance(n, _BAIL_NODES):
                 # break/continue inside a NESTED loop are that loop's
                 # business, not ours
                 if isinstance(n, (ast.Break, ast.Continue)):
                     if _inside_nested_loop(s, n):
                         continue
-                return True
-    return False
+                kw = _BAIL_KEYWORD.get(type(n), type(n).__name__)
+                return f"it contains `{kw}`"
+    return None
+
+
+def _has_bail(stmts) -> bool:
+    return _bail_reason(stmts) is not None
 
 
 def _inside_nested_loop(root_stmt, node) -> bool:
@@ -398,6 +867,319 @@ def _unpack_assign(out_names: List[str], value: ast.expr) -> ast.stmt:
     return ast.Assign(targets=[tgt], value=value)
 
 
+def _assign(name: str, value: ast.expr) -> ast.stmt:
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+
+def _lambda0(body: ast.expr) -> ast.expr:
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=body)
+
+
+def _not(e: ast.expr) -> ast.expr:
+    return ast.UnaryOp(op=ast.Not(), operand=e)
+
+
+def _contains_return(s) -> bool:
+    return any(isinstance(n, ast.Return) for n in _walk_stmt(s))
+
+
+def _always_returns(stmts) -> bool:
+    """Conservative terminal-path analysis: True when every way out of
+    this statement list is a `return` or `raise` (loops are assumed
+    skippable, so they never count)."""
+    for s in stmts:
+        if isinstance(s, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(s, ast.If) and s.orelse:
+            if _always_returns(s.body) and _always_returns(s.orelse):
+                return True
+    return False
+
+
+class _ReturnTransformer:
+    """Rewrite `return` inside control flow into a flag + value pair with
+    guarded tails (reference return_transformer.py:136,
+    early_return_transformer.py).  Inside a loop the flag set is followed
+    by `break` (consumed by _BreakContinueTransformer); after a nested
+    construct that may have returned, `if flag: break` (in a loop) or an
+    `if not flag:` tail guard (outside) keeps later statements from
+    running."""
+
+    def __init__(self, uid: int):
+        self.flag = f"{_GEN_PREFIX}ret_{uid}"
+        self.val = f"{_GEN_PREFIX}val_{uid}"
+        self.applied = False
+
+    def run(self, fdef):
+        if not any(isinstance(s, (ast.If, ast.While, ast.For))
+                   and _contains_return(s) for s in fdef.body):
+            return
+        always = _always_returns(fdef.body)
+        self.applied = True
+        body, _may = self._block(list(fdef.body), in_loop=False)
+        if always:
+            # every path returns → the flag is True at the end and the
+            # value is always well-defined
+            tail = ast.Return(value=_name(self.val))
+        else:
+            # fall-through possible → `val if flag else None`, with a
+            # clear error when the flag itself is traced (mixed
+            # tensor/None return structure cannot compile)
+            tail = ast.Return(value=ast.Call(
+                func=_jst_attr("ret_value"),
+                args=[_name(self.flag), _name(self.val)], keywords=[]))
+        fdef.body = [
+            _assign(self.flag, ast.Constant(False)),
+            _assign(self.val, ast.Constant(None)),
+        ] + body + [tail]
+
+    def _block(self, stmts, in_loop):
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(_assign(self.flag, ast.Constant(True)))
+                out.append(_assign(
+                    self.val, s.value if s.value is not None
+                    else ast.Constant(None)))
+                if in_loop:
+                    out.append(ast.Break())
+                return out, True           # rest is unreachable
+            if isinstance(s, (ast.If, ast.While, ast.For)) and \
+                    _contains_return(s):
+                s, smay = self._compound(s, in_loop)
+                out.append(s)
+                if smay:
+                    rest, _ = self._block(list(stmts[i + 1:]), in_loop)
+                    if in_loop:
+                        # a set flag must also exit this (enclosing) loop
+                        out.append(ast.If(test=_name(self.flag),
+                                          body=[ast.Break()], orelse=[]))
+                        out.extend(rest)
+                    elif rest:
+                        out.append(ast.If(test=_not(_name(self.flag)),
+                                          body=rest, orelse=[]))
+                    return out, True
+                continue
+            out.append(s)
+        return out, False
+
+    def _compound(self, s, in_loop):
+        if isinstance(s, ast.If):
+            b, m1 = self._block(list(s.body), in_loop)
+            o, m2 = self._block(list(s.orelse), in_loop)
+            s.body = b or [ast.Pass()]
+            s.orelse = o
+            return s, m1 or m2
+        # While / For: returns in the body exit via the injected break
+        b, m = self._block(list(s.body), in_loop=True)
+        s.body = b or [ast.Pass()]
+        if s.orelse:
+            o, m2 = self._block(list(s.orelse), in_loop)
+            s.orelse = o
+            m = m or m2
+        return s, m
+
+
+def _owned_bc(body_stmts):
+    """(has_break, has_continue) whose innermost enclosing loop is the
+    loop owning `body_stmts`.  With/Try are not entered: a break inside
+    them stays python (the region then bails, keeping the loop python —
+    consistent either way)."""
+    brk = cont = False
+
+    def scan(stmts):
+        nonlocal brk, cont
+        for s in stmts:
+            if isinstance(s, ast.Break):
+                brk = True
+            elif isinstance(s, ast.Continue):
+                cont = True
+            elif isinstance(s, ast.If):
+                scan(s.body)
+                scan(s.orelse)
+            # For/While own their inner break/continue; With/Try/defs
+            # are left alone on purpose
+    scan(body_stmts)
+    return brk, cont
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """break → loop flag folded into the loop condition; continue → flag
+    guarding the rest of the iteration (reference
+    break_continue_transformer.py:89).  For-range loops containing either
+    are rewritten into the equivalent while so the flag can live in the
+    condition."""
+
+    def __init__(self):
+        self._uid = 0
+        self.changed = False
+
+    def _next(self):
+        self._uid += 1
+        return self._uid
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)       # innermost loops first
+        has_brk, has_cont = _owned_bc(node.body)
+        if node.orelse or not (has_brk or has_cont):
+            return node
+        self.changed = True
+        uid = self._next()
+        brk = f"{_GEN_PREFIX}brk_{uid}"
+        cont = f"{_GEN_PREFIX}cont_{uid}"
+        body = self._block(list(node.body), brk, cont,
+                           has_brk, has_cont) or [ast.Pass()]
+        if has_cont:
+            body = [_assign(cont, ast.Constant(False))] + body
+        test = node.test
+        if has_brk:
+            test = ast.BoolOp(op=ast.And(),
+                              values=[_not(_name(brk)), test])
+        pre = []
+        if has_brk:
+            pre.append(_assign(brk, ast.Constant(False)))
+        if has_cont:
+            pre.append(_assign(cont, ast.Constant(False)))
+        return pre + [ast.While(test=test, body=body, orelse=[])]
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        has_brk, has_cont = _owned_bc(node.body)
+        if node.orelse or not (has_brk or has_cont):
+            return node
+        if (not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"):
+            return node     # non-range for keeps python break/continue
+        # Keep the `for range` form (so concrete bounds still unroll with
+        # a python index) and guard the whole body with the break flag:
+        # after a `break` the remaining iterations become no-ops, which
+        # both the unrolled and the lax-lowered paths handle.
+        self.changed = True
+        uid = self._next()
+        brk = f"{_GEN_PREFIX}brk_{uid}"
+        cont = f"{_GEN_PREFIX}cont_{uid}"
+        body = self._block(list(node.body), brk, cont,
+                           has_brk, has_cont) or [ast.Pass()]
+        if has_cont:
+            body = [_assign(cont, ast.Constant(False))] + body
+        pre = []
+        post = []
+        if has_brk:
+            # after a break python's loop target stays at the breaking
+            # iteration, but the flag-guarded loop keeps iterating as a
+            # no-op — freeze the target in a shadow that only advances
+            # while the loop is live, and restore it afterwards
+            shadow = f"{_GEN_PREFIX}tgt_{uid}"
+            tgt_name = (node.target.id
+                        if isinstance(node.target, ast.Name) else None)
+            if tgt_name is not None:
+                body = [_assign(shadow, _name(tgt_name))] + body
+                pre.append(_assign(shadow, _ld_expr(tgt_name)))
+                post.append(_assign(tgt_name, _name(shadow)))
+            body = [ast.If(test=_not(_name(brk)), body=body, orelse=[])]
+            pre.append(_assign(brk, ast.Constant(False)))
+        if has_cont:
+            pre.append(_assign(cont, ast.Constant(False)))
+        node.body = body
+        return pre + [node] + post
+
+    def _block(self, stmts, brk, cont, has_brk, has_cont):
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign(brk, ast.Constant(True)))
+                return out
+            if isinstance(s, ast.Continue):
+                out.append(_assign(cont, ast.Constant(True)))
+                return out
+            if isinstance(s, ast.If) and any(_owned_bc([s])):
+                s.body = self._block(list(s.body), brk, cont,
+                                     has_brk, has_cont) or [ast.Pass()]
+                s.orelse = self._block(list(s.orelse), brk, cont,
+                                       has_brk, has_cont)
+                out.append(s)
+                rest = self._block(list(stmts[i + 1:]), brk, cont,
+                                   has_brk, has_cont)
+                if rest:
+                    flags = []
+                    if has_brk:
+                        flags.append(_name(brk))
+                    if has_cont:
+                        flags.append(_name(cont))
+                    guard = flags[0] if len(flags) == 1 else \
+                        ast.BoolOp(op=ast.Or(), values=flags)
+                    out.append(ast.If(test=_not(guard), body=rest,
+                                      orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+
+class _LogicalTransformer(ast.NodeTransformer):
+    """and/or/not → short-circuit-preserving converter calls that lower
+    to logical ops on traced tensors (reference logical_transformer.py).
+    Operand evaluation is wrapped in lambdas so the python short-circuit
+    contract holds exactly for concrete values."""
+
+    def __init__(self):
+        self.changed = False
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        # walrus bindings would become lambda-local (PEP 572) and
+        # yield/await cannot live in a lambda at all — keep python
+        # semantics for such operands
+        for v in node.values:
+            for n in ast.walk(v):
+                if isinstance(n, (ast.NamedExpr, ast.Yield, ast.YieldFrom,
+                                  ast.Await)):
+                    return node
+        fname = ("convert_logical_and" if isinstance(node.op, ast.And)
+                 else "convert_logical_or")
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            expr = ast.Call(func=_jst_attr(fname),
+                            args=[_lambda0(v), _lambda0(expr)],
+                            keywords=[])
+        self.changed = True
+        return expr
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.changed = True
+            return ast.Call(func=_jst_attr("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+
 class _CallSiteWrapper(ast.NodeTransformer):
     """foo(args) -> _jst.cvt(foo)(args) for plain-name/attribute callees,
     so user helper functions get converted recursively (reference
@@ -421,13 +1203,18 @@ class _CallSiteWrapper(ast.NodeTransformer):
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, filename: str = "<unknown>"):
         self.changed = False
         self._uid = 0
+        self._filename = filename
 
     def _next(self, kind):
         self._uid += 1
         return f"__jst_{kind}_{self._uid}"
+
+    def _bail(self, node, construct, reason):
+        _record_bail(self._filename, node, construct, reason)
+        return node
 
     # do not descend into nested defs — they are separate conversions
     def visit_FunctionDef(self, node):
@@ -443,14 +1230,18 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_If(self, node: ast.If):
         self.generic_visit(node)   # innermost first
-        if _has_bail(node.body) or _has_bail(node.orelse):
-            return node
+        r = _bail_reason(node.body) or _bail_reason(node.orelse)
+        if r:
+            return self._bail(node, "if", r)
         assigned = sorted(_assigned_names(node.body)
                           | _assigned_names(node.orelse))
         if not assigned:
             # nothing flows out: conversion could only lose side-effect
             # semantics under tracing — keep the python if
-            return node
+            return self._bail(
+                node, "if",
+                "no variable assignment flows out of it (side-effect-"
+                "only branches stay python)")
         reads = sorted((_loaded_names(node.body)
                         | _loaded_names(node.orelse)
                         | _loaded_names([ast.Expr(node.test)])) - {"_jst"})
@@ -458,22 +1249,39 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         fname = self._next("false")
         true_def = _branch_funcdef(tname, reads, node.body, assigned)
         false_def = _branch_funcdef(fname, reads, node.orelse, assigned)
+        # a return-flag tail guard (`if not __jstf_ret_N:`) may fill
+        # one-sided assignments with placeholders: they are dead on the
+        # flag-set path (the function returns right after)
+        is_guard = any(
+            isinstance(m, ast.Name)
+            and m.id.startswith(_GEN_PREFIX + "ret")
+            for m in ast.walk(node.test))
+        kw = [ast.keyword(
+            arg="names",
+            value=ast.Tuple(elts=[ast.Constant(n) for n in assigned],
+                            ctx=ast.Load()))]
+        if is_guard:
+            kw.append(ast.keyword(arg="guard", value=ast.Constant(True)))
         call = ast.Call(
             func=_jst_attr("convert_ifelse"),
             args=[node.test, _name(tname), _name(fname),
                   ast.Tuple(elts=[_ld_expr(r) for r in reads],
                             ctx=ast.Load())],
-            keywords=[])
+            keywords=kw)
         self.changed = True
         return [true_def, false_def, _unpack_assign(assigned, call)]
 
     def visit_While(self, node: ast.While):
         self.generic_visit(node)
-        if node.orelse or _has_bail(node.body):
-            return node
+        if node.orelse:
+            return self._bail(node, "while", "it has an `else` clause")
+        r = _bail_reason(node.body)
+        if r:
+            return self._bail(node, "while", r)
         assigned = sorted(_assigned_names(node.body))
         if not assigned:
-            return node
+            return self._bail(node, "while",
+                              "no variable assignment flows out of it")
         reads = sorted((_loaded_names(node.body)
                         | _loaded_names([ast.Expr(node.test)]))
                        - set(assigned) - {"_jst"})
@@ -493,26 +1301,38 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             args=[_name(cname), _name(bname),
                   ast.Tuple(elts=[_ld_expr(n) for n in assigned],
                             ctx=ast.Load())],
-            keywords=[])
+            keywords=[ast.keyword(
+                arg="names",
+                value=ast.Tuple(elts=[ast.Constant(n) for n in assigned],
+                                ctx=ast.Load()))])
         self.changed = True
         return [cond_def, body_def, _unpack_assign(assigned, call)]
 
     def visit_For(self, node: ast.For):
         self.generic_visit(node)
         # only `for <name> in range(...)` without else
-        if (node.orelse or _has_bail(node.body)
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"):
+            return self._bail(
+                node, "for",
+                "it iterates a non-range iterable (tensor-dependent "
+                "iteration needs `for i in range(...)`)")
+        if (node.orelse
                 or not isinstance(node.target, ast.Name)
-                or not isinstance(node.iter, ast.Call)
-                or not isinstance(node.iter.func, ast.Name)
-                or node.iter.func.id != "range"
                 or node.iter.keywords
                 or not 1 <= len(node.iter.args) <= 3
                 or any(isinstance(a, ast.Starred)
                        for a in node.iter.args)):
-            return node
+            return self._bail(node, "for",
+                              "its range/target form is not convertible")
+        r = _bail_reason(node.body)
+        if r:
+            return self._bail(node, "for", r)
         assigned = sorted(_assigned_names(node.body) - {node.target.id})
         if not assigned:
-            return node
+            return self._bail(node, "for",
+                              "no variable assignment flows out of it")
         ra = node.iter.args
         if len(ra) == 1:
             start, stop, step = ast.Constant(0), ra[0], ast.Constant(1)
@@ -523,14 +1343,25 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         bname = self._next("forbody")
         body_def = _branch_funcdef(
             bname, [node.target.id] + assigned, node.body, assigned)
+        # the loop target is itself an output: python leaves it bound to
+        # the last iterated value after the loop, and user code reads it
         call = ast.Call(
             func=_jst_attr("convert_range_loop"),
             args=[start, stop, step, _name(bname),
                   ast.Tuple(elts=[_ld_expr(n) for n in assigned],
                             ctx=ast.Load())],
-            keywords=[])
+            keywords=[
+                ast.keyword(
+                    arg="names",
+                    value=ast.Tuple(
+                        elts=[ast.Constant(n) for n in assigned],
+                        ctx=ast.Load())),
+                ast.keyword(arg="target_init",
+                            value=_ld_expr(node.target.id)),
+            ])
         self.changed = True
-        return [body_def, _unpack_assign(assigned, call)]
+        return [body_def,
+                _unpack_assign([node.target.id] + assigned, call)]
 
 
 # ---------------------------------------------------------------------------
@@ -565,7 +1396,8 @@ def convert_function(fn):
     if getattr(fn, _CONVERTED_MARK, False):
         return fn if bound_self is None else fn.__get__(bound_self)
     try:
-        src = textwrap.dedent(inspect.getsource(fn))
+        src_lines, first_line = inspect.getsourcelines(fn)
+        src = textwrap.dedent("".join(src_lines))
         tree = ast.parse(src)
     except (OSError, TypeError, SyntaxError, IndentationError):
         return fn if bound_self is None else fn.__get__(bound_self)
@@ -579,14 +1411,37 @@ def convert_function(fn):
             setattr(fn, _CONVERTED_MARK, True)
             return fn if bound_self is None else fn.__get__(bound_self)
     fdef.decorator_list = []
-    tr = _ControlFlowTransformer()
+    filename = fn.__code__.co_filename
+    # map node linenos to FILE linenos before any transform, so bail
+    # records, tracebacks, and linecache all point at the user's source
+    # (reference origin_info.py)
+    ast.increment_lineno(tree, first_line - 1)
+    # generators/coroutines: `return` means StopIteration(value) and
+    # yield/await cannot cross generated function boundaries — leave the
+    # return machinery off (break/continue flags and call wrapping are
+    # still semantics-preserving for them)
+    is_gen = isinstance(fdef, ast.AsyncFunctionDef) or any(
+        isinstance(n, (ast.Yield, ast.YieldFrom, ast.Await))
+        for n in _walk_scope(fdef))
+    ret_tr = _ReturnTransformer(uid=abs(hash(fn.__qualname__)) % 9973)
+    if not is_gen:
+        ret_tr.run(fdef)
+    bc_tr = _BreakContinueTransformer()
+    fdef.body = [x for stmt in fdef.body
+                 for x in _as_list(bc_tr.visit(stmt))]
+    log_tr = _LogicalTransformer()
+    fdef.body = [x for stmt in fdef.body
+                 for x in _as_list(log_tr.visit(stmt))]
+    tr = _ControlFlowTransformer(filename=filename)
     fdef.body = [x for stmt in fdef.body
                  for x in _as_list(tr.visit(stmt))]
     # call-site wrapping lets helpers reached from here convert too
     # (reference convert_call); only worth the indirection when this
     # function itself converts, or when it might CALL converting code
     _CallSiteWrapper().visit(fdef)
-    if not tr.changed and not _has_user_calls(fdef):
+    changed = (tr.changed or ret_tr.applied or bc_tr.changed
+               or log_tr.changed)
+    if not changed and not _has_user_calls(fdef):
         setattr(fn, _CONVERTED_MARK, True)
         return fn if bound_self is None else fn.__get__(bound_self)
     ast.fix_missing_locations(tree)
@@ -603,8 +1458,7 @@ def convert_function(fn):
             except ValueError:   # empty cell
                 pass
     ns = _LiveGlobals(fn.__globals__, extras)
-    code = compile(tree, filename=f"<dy2static {fn.__code__.co_filename}>",
-                   mode="exec")
+    code = compile(tree, filename=filename, mode="exec")
     exec(code, ns)
     new_fn = ns[fdef.name]
     functools.update_wrapper(new_fn, fn)

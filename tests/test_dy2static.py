@@ -10,7 +10,11 @@ from paddle_tpu.jit.dy2static import (
 
 def _was_converted(fn):
     g = convert_function(fn)
-    return g, g.__code__.co_filename.startswith("<dy2static")
+    # converted functions are re-compiled against the ORIGINAL file (so
+    # tracebacks map to user source); recognize them by the mark plus a
+    # fresh code object
+    return g, (getattr(g, "__jst_converted__", False)
+               and g.__code__ is not fn.__code__)
 
 
 # ---------------------------------------------------------------------------
