@@ -91,7 +91,14 @@ def peak_flops_per_chip() -> float:
     return 197e12
 
 
-def main():
+def build_bench(smoke: bool = False):
+    """Create the EXACT model/optimizer/train-step main() times.
+
+    Returns (make_step, cfg, seq, model): ``make_step(batch) ->
+    (train_step, x, y)``.  Shared with tools/perf_fingerprint.py, which
+    compiles (but does not run) the same program to fingerprint its HLO —
+    keeping the fingerprint honest about what the bench really runs.
+    """
     import paddle_tpu as paddle
     from paddle_tpu.models import gpt2_345m, GPTForCausalLM
     from paddle_tpu.distributed import fleet
@@ -99,8 +106,6 @@ def main():
     strategy = paddle.distributed.DistributedStrategy()
     fleet.init(is_collective=True, strategy=strategy)
 
-    import os
-    import jax
     paddle.seed(0)
     # Tuned on v5e: dropout 0 (standard MFU-bench practice; also engages
     # the Pallas flash kernel, whose dispatch guard requires p==0),
@@ -109,7 +114,7 @@ def main():
     # fused_linear_cross_entropy (vocab-blockwise streamed CE): no [B,S,V]
     # logits tensor is ever materialized, which un-caps the batch that
     # previously OOMed at 16 on the f32 logits temp.
-    if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
+    if smoke:
         # correctness smoke of the exact bench path on tiny shapes (CPU ok)
         from paddle_tpu.models import gpt_tiny
 
@@ -119,9 +124,6 @@ def main():
         cfg = gpt2_345m(recompute=False, hidden_dropout_prob=0.0,
                         attention_probs_dropout_prob=0.0)
         seq = 1024
-    # batch 8/chip is the v5e sweet spot: 16 and 32 scale step time
-    # linearly with no MFU gain (measured 0.418 @ 8 vs 0.387 @ 16)
-    per_chip = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "8"))
     model = fleet.distributed_model(GPTForCausalLM(cfg))
     opt = fleet.distributed_optimizer(
         paddle.optimizer.AdamW(learning_rate=1e-4,
@@ -136,7 +138,7 @@ def main():
 
     rs = np.random.RandomState(0)
 
-    def run_at(batch):
+    def make_step(batch):
         @paddle.jit.to_static
         def train_step(x, y):
             with paddle.amp.auto_cast(dtype="bfloat16", level=amp_level):
@@ -148,6 +150,23 @@ def main():
 
         x = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
         y = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)))
+        return train_step, x, y
+
+    return make_step, cfg, seq, model
+
+
+def main():
+    import os
+    import jax
+
+    smoke = bool(os.environ.get("PADDLE_TPU_BENCH_SMOKE"))
+    make_step, cfg, seq, model = build_bench(smoke=smoke)
+    # batch 8/chip is the v5e sweet spot: 16 and 32 scale step time
+    # linearly with no MFU gain (measured 0.418 @ 8 vs 0.387 @ 16)
+    per_chip = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "8"))
+
+    def run_at(batch):
+        train_step, x, y = make_step(batch)
         for _ in range(3):          # warmup (compile)
             loss = train_step(x, y)
         float(loss)

@@ -227,6 +227,10 @@ class ElasticManager:
         self.stopped = False
         self.need_sync = False
         self._elastic_startup_time = None
+        # worker-fault relaunch budget (reference fault-tolerance window,
+        # _update_fault_tolrance :443); launch wires --max_restarts here
+        self.fault_count = 0
+        self.max_faults = 3
 
         # register self under a lease and keep it alive
         self._lease = self.coord.lease(self.lease_ttl)
@@ -396,6 +400,12 @@ class ElasticManager:
                     self.exit(completed=True)
                     return ElasticStatus.COMPLETED
                 if rc == ELASTIC_EXIT_CODE:
+                    return ElasticStatus.RESTART
+                # reference manager.py:577 — at FAULT_TOLERANCE/ELASTIC
+                # level ANY worker fault relaunches the round (recovery
+                # comes from checkpoints), bounded by the fault budget
+                self.fault_count += 1
+                if self.fault_count <= self.max_faults:
                     return ElasticStatus.RESTART
                 return ElasticStatus.ERROR
             time.sleep(poll)

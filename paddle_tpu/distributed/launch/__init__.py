@@ -45,8 +45,9 @@ def _parse(argv: Optional[List[str]] = None):
                    help="comma-separated node hostnames, node_rank order "
                         "(required for --nnodes > 1)")
     p.add_argument("--log_dir", type=str, default=None)
-    p.add_argument("--max_restarts", type=int, default=0,
-                   help="restarts after worker failure before giving up")
+    p.add_argument("--max_restarts", type=int, default=None,
+                   help="restarts after worker failure before giving up "
+                        "(default: 0 for plain launch, 3 for elastic)")
     p.add_argument("--start_port", type=int,
                    default=int(os.environ.get("PADDLE_START_PORT", "6170")))
     p.add_argument("--elastic_coordinator", type=str,
@@ -168,6 +169,10 @@ def _launch_elastic(args) -> int:
     manager = ElasticManager(coord, job_id=args.job_id,
                              np=args.np or str(args.nnodes),
                              curr_host=curr)
+    if args.max_restarts is not None:
+        # 0 is a real request: a deterministic crash should error out,
+        # not burn the default 3-fault budget
+        manager.max_faults = args.max_restarts
 
     class _Launcher(LauncherInterface):
         def __init__(self):
@@ -273,6 +278,8 @@ def launch(argv: Optional[List[str]] = None) -> int:
     if args.elastic_coordinator:
         return _launch_elastic(args)
 
+    if args.max_restarts is None:
+        args.max_restarts = 0      # plain launch: no implicit restarts
     restarts = 0
     while True:
         workers = _build_workers(args, master)

@@ -278,6 +278,24 @@ def _logical_binop(op, x, y):
     return Tensor._wrap(op(xa, ya))
 
 
+class _RetNone:
+    """Singleton marking an EXPLICIT bare `return` / `return None` in a
+    converted function — distinguishable from 'value never assigned'
+    (plain None), which the branch unifier may placeholder-fill."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<bare return>"
+
+
+RET_NONE = _RetNone()
+
+
+def ret_unwrap(val):
+    return None if isinstance(val, _RetNone) else val
+
+
 def ret_value(flag, val):
     """Final return of a converted function that has a fall-through path
     (not every path returns): python semantics are `val if returned else
@@ -292,7 +310,7 @@ def ret_value(flag, val):
             "depends on a traced Tensor; a compiled program needs one "
             "return structure — add an explicit `return` to the "
             "fall-through path")
-    return val if _truthy(flag) else None
+    return ret_unwrap(val) if _truthy(flag) else None
 
 
 # generated flag/value variables (return flags, break/continue flags, loop
@@ -431,6 +449,17 @@ def _unify_slot(t, f, name, guard=False):
     assigned there is dead on the flag-set path (the function returns
     immediately after), so missing-side placeholders are always safe."""
     t_missing, f_missing = _is_missing(t), _is_missing(f)
+    if isinstance(t, _RetNone) or isinstance(f, _RetNone):
+        # an EXPLICIT bare return on one side cannot be placeholder-
+        # filled: the function would return None or a tensor depending on
+        # a traced value
+        if _const_equal(type(t), type(f)):
+            return ("const", t)
+        raise Dy2StaticError(
+            "this function returns a value on one path and bare "
+            "`return`/None on another inside a traced `if`; a compiled "
+            "program needs one return structure — return a tensor on "
+            "every path")
     if t_missing and f_missing:
         return ("const", t if t is not None else f)
     if t_missing or f_missing:
@@ -571,23 +600,10 @@ def _check_loop_carry(names, vars_, probe):
                 "before the loop so the compiled loop can carry it")
 
 
+# abstract body probe: identical contract to the branch probe — one
+# implementation serves both (defined with convert_ifelse below)
 def _probe_body(body_fn, vars_):
-    cap = {}
-
-    def f(*arrs):
-        it = iter(arrs)
-        full = [Tensor._wrap(next(it)) if isinstance(o, Tensor) else o
-                for o in vars_]
-        from ..core import autograd
-        with autograd.no_grad():
-            out = body_fn(*full)
-        cap["outs"] = tuple(out) if isinstance(out, (tuple, list)) \
-            else (out,)
-        return jnp.zeros(())
-
-    jax.eval_shape(
-        f, *[o._value() for o in vars_ if isinstance(o, Tensor)])
-    return cap["outs"]
+    return _probe_branch(body_fn, vars_)
 
 
 def convert_while(cond_fn, body_fn, init_vars, names=None):
@@ -922,8 +938,10 @@ class _ReturnTransformer:
         body, _may = self._block(list(fdef.body), in_loop=False)
         if always:
             # every path returns → the flag is True at the end and the
-            # value is always well-defined
-            tail = ast.Return(value=_name(self.val))
+            # value is always well-defined (unwrap a bare-return marker)
+            tail = ast.Return(value=ast.Call(
+                func=_jst_attr("ret_unwrap"), args=[_name(self.val)],
+                keywords=[]))
         else:
             # fall-through possible → `val if flag else None`, with a
             # clear error when the flag itself is traced (mixed
@@ -941,9 +959,15 @@ class _ReturnTransformer:
         for i, s in enumerate(stmts):
             if isinstance(s, ast.Return):
                 out.append(_assign(self.flag, ast.Constant(True)))
+                # bare `return` / `return None` stores the RET_NONE
+                # sentinel, NOT None — plain None means "never assigned"
+                # to the branch unifier
+                bare = s.value is None or (
+                    isinstance(s.value, ast.Constant)
+                    and s.value.value is None)
                 out.append(_assign(
-                    self.val, s.value if s.value is not None
-                    else ast.Constant(None)))
+                    self.val,
+                    _jst_attr("RET_NONE") if bare else s.value))
                 if in_loop:
                     out.append(ast.Break())
                 return out, True           # rest is unreachable

@@ -393,6 +393,16 @@ class CompiledProgram:
                                     - ma.alias_size_in_bytes)
         except Exception:
             pass
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            out["cost"] = {
+                k.replace(" ", "_"): float(ca[k])
+                for k in ("flops", "bytes accessed", "transcendentals")
+                if k in ca}
+        except Exception:
+            pass
         return out
 
     def _writeback(self, write_arrays):

@@ -298,10 +298,13 @@ class _GPTHeadPipe(Layer):
 
 def GPTForCausalLMPipe(config: GPTConfig, topology=None,
                        num_stages: Optional[int] = None,
-                       recompute_interval: int = 0):
+                       recompute_interval: int = 0,
+                       num_virtual_pipeline_stages: Optional[int] = None):
     """Pipeline-parallel GPT (reference: the GPTForCausalLMPipe pattern of
     hybrid_parallel_pp_transformer.py) — a PipelineLayer whose uniform
-    decoder stack compiles onto the "pipe" mesh axis."""
+    decoder stack compiles onto the "pipe" mesh axis.
+    num_virtual_pipeline_stages > 1 selects the interleaved 1F1B schedule
+    (reference pp_layers.py:162 interleaved segmentation)."""
     from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
         PipelineLayer,
     )
@@ -315,7 +318,8 @@ def GPTForCausalLMPipe(config: GPTConfig, topology=None,
     return PipelineLayer(
         layers, num_stages=num_stages, topology=topology,
         loss_fn=lambda logits, labels: crit(logits, labels),
-        recompute_interval=recompute_interval)
+        recompute_interval=recompute_interval,
+        num_virtual_pipeline_stages=num_virtual_pipeline_stages)
 
 
 class GPTPretrainingCriterion(Layer):

@@ -1,0 +1,95 @@
+"""2-process eager functional-collective runner (reference:
+unittests/test_collective_base.py:33 — N subprocesses, rendezvous, assert
+tensor equality after each collective).
+
+Each process holds only ITS row of the stacked tensor; the global view is
+assembled with jax.make_array_from_process_local_data and the same
+stacked-semantics functional API used single-controller then executes the
+real cross-process collective (gloo on CPU, ICI on TPU pods)."""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main():
+    penv = dist.init_parallel_env()
+    rank, world = penv.rank, penv.world_size
+    assert jax.process_count() == world, (jax.process_count(), world)
+    from paddle_tpu.distributed.collective import Group, _world_group
+
+    g = _world_group()
+    sh = NamedSharding(g.mesh, P(Group.AXIS))
+
+    def stacked(local_np):
+        """Global [W, ...] stacked tensor; this process supplies row
+        `rank`."""
+        local = np.asarray(local_np)[None]
+        return Tensor._wrap(jax.make_array_from_process_local_data(
+            sh, local, (world,) + local.shape[1:]))
+
+    def myrow(t):
+        return np.asarray(t._value().addressable_data(0))[0]
+
+    base = np.arange(4, dtype=np.float32)
+    done = []
+
+    # all_reduce: every row -> sum of contributions
+    t = stacked(base + rank * 10)
+    dist.all_reduce(t)
+    np.testing.assert_allclose(myrow(t), 2 * base + 10)
+    done.append("all_reduce")
+
+    # broadcast from rank 1
+    t = stacked(base + rank * 10)
+    dist.broadcast(t, src=1)
+    np.testing.assert_allclose(myrow(t), base + 10)
+    done.append("broadcast")
+
+    # all_gather: my row becomes the full stack
+    t = stacked(base + rank * 10)
+    out = dist.all_gather(t)
+    np.testing.assert_allclose(
+        myrow(out), np.stack([base, base + 10]))
+    done.append("all_gather")
+
+    # alltoall: out[i][j] = in[j][i]
+    payload = np.stack([base + rank * 10 + j for j in range(world)])
+    t = stacked(payload)
+    out = dist.alltoall(t)
+    want = np.stack([base + j * 10 + rank for j in range(world)])
+    np.testing.assert_allclose(myrow(out), want)
+    done.append("alltoall")
+
+    # reduce to dst=0: only rank 0's row gets the sum
+    t = stacked(base + rank * 10)
+    dist.reduce(t, dst=0)
+    want = 2 * base + 10 if rank == 0 else base + 10
+    np.testing.assert_allclose(myrow(t), want)
+    done.append("reduce")
+
+    # ppermute — the p2p (send_v2/recv_v2) equivalent: swap rank rows
+    t = stacked(base + rank * 10)
+    out = dist.ppermute(t, perm=[(0, 1), (1, 0)])
+    np.testing.assert_allclose(myrow(out), base + (1 - rank) * 10)
+    done.append("ppermute")
+
+    print("COLLECTIVE_2PROC_OK", rank, ",".join(done), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Elastic kill-and-resume worker (reference: the dist_mnist.py-style
+runner scripts of test_dist_base.py:786 + elastic manager recovery).
+
+Trains a tiny DP model for N steps, checkpointing every step; on boot it
+resumes from the latest checkpoint.  When PADDLE_TEST_KILL_STEP is set
+and the marker file does not exist yet, the highest-rank worker hard-dies
+at that step (first generation only) — the launcher/elastic layer must
+detect it, regenerate ranks, and restart; the loss history across the
+death must equal an uninterrupted run's."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+
+
+def main():
+    penv = paddle.distributed.init_parallel_env()
+    rank = penv.rank
+    world = max(penv.world_size, 1)
+
+    ckpt_dir = os.environ["PADDLE_TEST_CKPT_DIR"]
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt = os.path.join(ckpt_dir, "state.pdparams")
+    kill_step = int(os.environ.get("PADDLE_TEST_KILL_STEP", "-1"))
+    marker = os.environ.get("PADDLE_TEST_KILL_MARKER")
+
+    rs = np.random.RandomState(0)
+    GLOBAL_B = 16
+    X = rs.randn(GLOBAL_B, 8).astype(np.float32)
+    W = rs.randn(8, 2).astype(np.float32)
+    Y = X @ W
+    local = GLOBAL_B // world
+    Xl = X[rank * local:(rank + 1) * local]
+    Yl = Y[rank * local:(rank + 1) * local]
+
+    paddle.seed(0)
+    model = paddle.distributed.DataParallel(nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+
+    start_step, losses = 0, []
+    if os.path.exists(ckpt):
+        state = paddle.load(ckpt)
+        model.set_state_dict(state["model"])
+        opt.set_state_dict(state["opt"])
+        start_step = int(state["step"])
+        losses = list(state["losses"])
+        print(f"[worker {rank}] resumed from step {start_step}",
+              file=sys.stderr, flush=True)
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = paddle.to_tensor(Xl)
+    y = paddle.to_tensor(Yl)
+
+    N = 10
+    for step in range(start_step, N):
+        loss = train_step(x, y)
+        losses.append(float(loss))
+        if rank == 0:
+            paddle.save({"model": model.state_dict(),
+                         "opt": opt.state_dict(),
+                         "step": step + 1, "losses": losses}, ckpt)
+        if (kill_step == step and rank == world - 1 and marker
+                and not os.path.exists(marker)):
+            open(marker, "w").write("died")
+            print(f"[worker {rank}] simulated death at step {step}",
+                  file=sys.stderr, flush=True)
+            os._exit(7)
+
+    if rank == 0:
+        out = os.environ.get("PADDLE_TEST_OUT")
+        if out:
+            json.dump(losses, open(out, "w"))
+    print(f"[worker {rank}] done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -344,6 +344,28 @@ class TestBailErrors:
         with pytest.raises(Exception, match="'z'"):
             g(_pos())
 
+    def test_bare_return_in_traced_if_raises_named(self):
+        # bare `return` + tensor return under a traced pred cannot
+        # compile to one structure — must error, never return zeros
+        def f(x):
+            if x.sum() > 0:
+                return
+            return x * 2.0
+
+        g = paddle.jit.to_static(f)
+        with pytest.raises(Dy2StaticError, match="return structure"):
+            g(_pos())
+
+    def test_bare_return_concrete_exact(self):
+        def f(n):
+            if n > 0:
+                return
+            return n * 2
+
+        g = convert_function(f)
+        assert g(1) is None
+        assert g(-2) == -4
+
     def test_none_fallthrough_under_traced_pred_raises(self):
         def f(x):
             if x.sum() > 0:

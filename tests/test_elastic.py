@@ -176,12 +176,17 @@ class TestWatchLoop:
         assert m._completed()
 
     def test_error(self):
+        # a worker fault relaunches (reference manager.py:577
+        # FAULT_TOLERANCE) until the fault budget runs out, then errors
         coord = InMemoryCoordinator()
         m = mk(coord, "h1:6170", np="1")
+        m.max_faults = 2
         m.wait(timeout=2); m.sync()
         launcher = FakeLauncher()
         m.run(launcher)
         launcher.rc = 1
+        assert m.watch() == ElasticStatus.RESTART
+        assert m.watch() == ElasticStatus.RESTART
         assert m.watch() == ElasticStatus.ERROR
         m.exit()
 

@@ -1,0 +1,197 @@
+"""Op schema → API consistency gate (reference:
+python/paddle/utils/code_gen/api_gen.py — one source of truth for op
+signatures; here the OpSpec tables play that role).
+
+Three invariants, all default-on:
+
+1. Every enrolled op's LIVE python signature matches the tracked
+   docs/op_signatures.json snapshot — signature drift fails until the
+   table is regenerated (`python tools/op_signatures.py`).
+2. Every enrolled op's schema row is CALLABLE against the live
+   signature (sample-input arity + kwargs names bind cleanly).
+3. Every exported op-like callable on paddle.* / nn.functional is either
+   enrolled in the SPECS tables or explicitly justified below — a new op
+   cannot ship silently untested.
+"""
+import inspect
+import json
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from test_op_suite import SPECS
+from test_op_suite_extra import SPECS2
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SNAPSHOT = os.path.join(REPO, "docs", "op_signatures.json")
+
+ALL_SPECS = list(SPECS) + list(SPECS2)
+ENROLLED = {s.name for s in ALL_SPECS}
+
+# Exported callables deliberately NOT in the numeric-grad op harness,
+# each with the reason (and where coverage lives instead).  A new export
+# missing from both ENROLLED and this table fails test_every_export_
+# enrolled_or_justified.
+_INPLACE = ("in-place alias of the enrolled out-of-place op; covered by "
+            "the 222/222 tensor-method table (test_tensor)")
+_CREATION = ("creation/random op — no numeric-gradient oracle; covered "
+             "by test_tensor / test_ops creation tests")
+_RUNTIME = "runtime/config/introspection helper, not a tensor op"
+_STATEFUL = ("stochastic or stateful training op — covered by dedicated "
+             "tests (test_nn / test_amp), not point-wise oracles")
+_DECOMP = ("linalg decomposition with sign/permutation ambiguity — "
+           "covered by property-based checks in test_fft_signal / "
+           "test_ops (A = Q@R style reconstruction), not element oracles")
+_INTERNAL = ("dispatch-layer internal that leaks into dir(F); not part "
+             "of the public op surface")
+_IO = "serialization / io — covered by test_io"
+_COMPOSITE = ("composite convenience wrapper over enrolled primitives; "
+              "covered by its own test file")
+
+JUSTIFIED = {
+    # in-place variants
+    "ceil_": _INPLACE, "elu_": _INPLACE, "erfinv_": _INPLACE,
+    "exp_": _INPLACE, "exponential_": _CREATION, "flatten_": _INPLACE,
+    "floor_": _INPLACE, "lerp_": _INPLACE, "normal_": _CREATION,
+    "put_along_axis_": _INPLACE, "reciprocal_": _INPLACE,
+    "relu_": _INPLACE, "reshape_": _INPLACE, "round_": _INPLACE,
+    "rsqrt_": _INPLACE, "scatter_": _INPLACE, "sqrt_": _INPLACE,
+    "squeeze_": _INPLACE, "tanh_": _INPLACE, "uniform_": _CREATION,
+    "unsqueeze_": _INPLACE, "is_grad_enabled_": _RUNTIME,
+    # creation / random
+    "arange": _CREATION, "empty": _CREATION, "eye": _CREATION,
+    "full": _CREATION, "linspace": _CREATION, "logspace": _CREATION,
+    "ones": _CREATION, "zeros": _CREATION, "rand": _CREATION,
+    "randn": _CREATION, "randint": _CREATION, "randperm": _CREATION,
+    "normal": _CREATION, "uniform": _CREATION, "poisson": _CREATION,
+    "standard_normal": _CREATION, "tril_indices": _CREATION,
+    "triu_indices": _CREATION, "to_tensor": _CREATION,
+    "create_parameter": _CREATION, "clone_like": _INTERNAL,
+    # runtime / config / introspection
+    "broadcast_shape": _RUNTIME, "check_shape": _INTERNAL,
+    "define_flag": _RUNTIME, "disable_signal_handler": _RUNTIME,
+    "disable_static": _RUNTIME, "enable_static": _RUNTIME,
+    "enable_grad": _RUNTIME, "no_grad": _RUNTIME,
+    "set_grad_enabled": _RUNTIME, "is_grad_enabled": _RUNTIME,
+    "finfo": _RUNTIME, "iinfo": _RUNTIME, "flops": _RUNTIME,
+    "get_cuda_rng_state": _RUNTIME, "set_cuda_rng_state": _RUNTIME,
+    "get_cudnn_version": _RUNTIME, "get_default_dtype": _RUNTIME,
+    "set_default_dtype": _RUNTIME, "get_device": _RUNTIME,
+    "set_device": _RUNTIME, "get_flags": _RUNTIME, "set_flags": _RUNTIME,
+    "get_rng_state": _RUNTIME, "set_rng_state": _RUNTIME,
+    "seed": _RUNTIME, "next_key": _INTERNAL,
+    "in_dynamic_mode": _RUNTIME, "is_compiled_with_cinn": _RUNTIME,
+    "is_compiled_with_cuda": _RUNTIME, "is_compiled_with_npu": _RUNTIME,
+    "is_compiled_with_rocm": _RUNTIME, "is_compiled_with_tpu": _RUNTIME,
+    "is_compiled_with_xpu": _RUNTIME, "is_complex": _RUNTIME,
+    "is_floating_point": _RUNTIME, "is_integer": _RUNTIME,
+    "is_tensor": _RUNTIME, "rank": _RUNTIME, "shape": _RUNTIME,
+    "set_printoptions": _RUNTIME, "summary": _RUNTIME,
+    "tolist": _RUNTIME, "astype": _RUNTIME, "grad": _RUNTIME,
+    # io
+    "save": _IO, "load": _IO,
+    # stochastic / stateful nn ops
+    "dropout": _STATEFUL, "dropout2d": _STATEFUL, "dropout3d": _STATEFUL,
+    "alpha_dropout": _STATEFUL, "rrelu": _STATEFUL,
+    "batch_norm": _STATEFUL, "instance_norm": _STATEFUL,
+    "group_norm": _COMPOSITE, "rms_norm": _COMPOSITE,
+    "class_center_sample": _STATEFUL,
+    "margin_cross_entropy": _COMPOSITE, "hsigmoid_loss": _COMPOSITE,
+    "gather_tree": _COMPOSITE, "sparse_attention": _COMPOSITE,
+    "scaled_dot_product_attention": _COMPOSITE,
+    "fused_linear_cross_entropy": (
+        "enrolled as fused_linear_ce (labels need int sampling)"),
+    "max_unpool1d": _COMPOSITE, "max_unpool2d": _COMPOSITE,
+    "max_unpool3d": _COMPOSITE, "embedding": (
+        "enrolled via F.embedding spec; the paddle.* alias shares it"),
+    # linalg decompositions (sign/permutation ambiguity)
+    "eig": _DECOMP, "eigh": _DECOMP, "eigvals": _DECOMP, "svd": _DECOMP,
+    "lu": _DECOMP, "lu_unpack": _DECOMP, "inv": _DECOMP, "cond": _DECOMP,
+    # complex views
+    "as_complex": ("complex-view op; covered with `complex` spec + "
+                   "test_fft_signal"),
+    # dispatch internals that show up in dir(F) (no __all__ there)
+    "apply_op": _INTERNAL, "batch": _INTERNAL, "op": _INTERNAL,
+    "nondiff": _INTERNAL, "wrap": _INTERNAL, "unwrap": _INTERNAL,
+    "as_int_list": _INTERNAL, "paddle_reshape_shape": _INTERNAL,
+    "register_tensor_method": _INTERNAL,
+}
+
+
+def _universe():
+    names = {}
+    for mod in (paddle, F):
+        for n in getattr(mod, "__all__", None) or dir(mod):
+            if n.startswith("_"):
+                continue
+            o = getattr(mod, n, None)
+            if inspect.isfunction(o) or inspect.isbuiltin(o):
+                names[n] = o
+    return names
+
+
+def test_every_export_enrolled_or_justified():
+    uni = _universe()
+    unaccounted = sorted(n for n in uni
+                         if n not in ENROLLED and n not in JUSTIFIED)
+    assert not unaccounted, (
+        "exported ops missing from the op harness AND the justified "
+        f"list — enroll them in SPECS/SPECS2 or justify here: "
+        f"{unaccounted}")
+
+
+def test_justified_entries_still_exist():
+    # a justification for a removed export is stale — keep the table live
+    uni = _universe()
+    stale = sorted(n for n in JUSTIFIED
+                   if n not in uni and n not in ENROLLED)
+    assert not stale, f"JUSTIFIED entries no longer exported: {stale}"
+
+
+def test_signatures_match_tracked_snapshot():
+    assert os.path.exists(SNAPSHOT), (
+        "docs/op_signatures.json missing — regenerate with "
+        "`python tools/op_signatures.py`")
+    with open(SNAPSHOT) as f:
+        tracked = json.load(f)
+    drift = []
+    for spec in ALL_SPECS:
+        fn = spec.resolve()
+        try:
+            live = str(inspect.signature(fn))
+        except (TypeError, ValueError):
+            live = "<builtin>"
+        t = tracked.get(spec.name)
+        if t is None:
+            drift.append(f"{spec.name}: not in snapshot")
+        elif t["signature"] != live:
+            drift.append(
+                f"{spec.name}: live {live} != tracked {t['signature']}")
+    assert not drift, (
+        "op signatures drifted from docs/op_signatures.json — if "
+        "intentional, regenerate with `python tools/op_signatures.py`:\n"
+        + "\n".join(drift))
+
+
+def test_schema_rows_bind_to_live_signatures():
+    # the sample-input arity + kwargs of every schema row must BIND to
+    # the live callable — catches rows drifting from the API they test
+    errors = []
+    for spec in ALL_SPECS:
+        fn = spec.resolve()
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            continue
+        try:
+            sig.bind(*([object()] * len(spec.inputs)), **spec.kwargs)
+        except TypeError as e:
+            errors.append(f"{spec.name}: {e}")
+    assert not errors, "\n".join(errors)
+
+
+def test_enrollment_never_shrinks():
+    assert len(ALL_SPECS) >= 362, (
+        f"op enrollment dropped to {len(ALL_SPECS)} (r5 floor: 362)")

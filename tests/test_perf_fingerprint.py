@@ -1,0 +1,64 @@
+"""Offline perf-regression gate: the compiled bench step's structure
+(FLOPs, bytes, HLO op mix) must match the tracked PERF_FINGERPRINT.json,
+so perf cannot silently rot while TPU hardware is unreachable
+(reference analog: tools/check_op_benchmark_result.py:70 — the op-perf
+PR-vs-develop gate)."""
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "PERF_FINGERPRINT.json")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+
+def _load_tracked():
+    assert os.path.exists(ARTIFACT), (
+        "PERF_FINGERPRINT.json is a tracked artifact; regenerate with "
+        "`python tools/perf_fingerprint.py`")
+    with open(ARTIFACT) as f:
+        return json.load(f)
+
+
+def test_smoke_fingerprint_matches_tracked():
+    import jax
+
+    import perf_fingerprint as pf
+
+    tracked = _load_tracked()
+    assert "smoke" in tracked
+    if tracked["smoke"].get("jax_version") != jax.__version__:
+        pytest.skip("jax version changed; regenerate the fingerprint")
+    cur = pf.fingerprint(smoke=True, batch=2)
+    drift = pf.compare(tracked["smoke"], cur)
+    assert not drift, "\n".join(
+        ["compiled bench-step structure drifted from the tracked "
+         "fingerprint (run tools/perf_fingerprint.py if intentional):"]
+        + drift)
+
+
+def test_fingerprint_has_cost_and_counts():
+    tracked = _load_tracked()
+    smoke = tracked["smoke"]
+    assert smoke["cost"].get("flops", 0) > 0
+    assert smoke["hlo_counts"]["dot"] > 0
+    assert smoke["n_params"] > 0
+
+
+@pytest.mark.slow
+def test_full_fingerprint_matches_tracked():
+    import jax
+
+    import perf_fingerprint as pf
+
+    tracked = _load_tracked()
+    if "full" not in tracked:
+        pytest.skip("full fingerprint not generated yet")
+    if tracked["full"].get("jax_version") != jax.__version__:
+        pytest.skip("jax version changed; regenerate the fingerprint")
+    cur = pf.fingerprint(smoke=False, batch=8)
+    drift = pf.compare(tracked["full"], cur)
+    assert not drift, "\n".join(drift)

@@ -1,0 +1,59 @@
+"""Generate the tracked op-signature table from the enrolled SPECS rows.
+
+The reference generates its C++ API from api.yaml
+(python/paddle/utils/code_gen/api_gen.py) so op signatures have one
+source of truth.  Here the OpSpec tables are that source for tests+docs;
+this tool snapshots the LIVE python signature of every enrolled op into
+docs/op_signatures.json, and tests/test_op_schema_gate.py fails when a
+live signature drifts from the snapshot — signature changes must ship
+with a regenerated table, never silently.
+
+Usage: python tools/op_signatures.py
+"""
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+OUT = os.path.join(REPO, "docs", "op_signatures.json")
+
+
+def live_signatures():
+    from test_op_suite import SPECS
+    from test_op_suite_extra import SPECS2
+
+    sigs = {}
+    for spec in list(SPECS) + list(SPECS2):
+        fn = spec.resolve()
+        try:
+            sig = str(inspect.signature(fn))
+        except (TypeError, ValueError):
+            sig = "<builtin>"
+        sigs[spec.name] = {
+            "signature": sig,
+            "n_sample_inputs": len(spec.inputs),
+            "kwargs": sorted(spec.kwargs),
+        }
+    return sigs
+
+
+def main():
+    sigs = live_signatures()
+    with open(OUT, "w") as f:
+        json.dump(sigs, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {OUT}: {len(sigs)} op signatures")
+
+
+if __name__ == "__main__":
+    main()
