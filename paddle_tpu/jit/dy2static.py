@@ -450,11 +450,13 @@ def _unify_slot(t, f, name, guard=False):
     immediately after), so missing-side placeholders are always safe."""
     t_missing, f_missing = _is_missing(t), _is_missing(f)
     if isinstance(t, _RetNone) or isinstance(f, _RetNone):
-        # an EXPLICIT bare return on one side cannot be placeholder-
-        # filled: the function would return None or a tensor depending on
-        # a traced value
-        if _const_equal(type(t), type(f)):
-            return ("const", t)
+        # bare return on one side: compatible with another bare return or
+        # with "not returned yet" (the value stays None either way), but
+        # NOT with a tensor — that would make the return structure depend
+        # on a traced value
+        other = f if isinstance(t, _RetNone) else t
+        if isinstance(other, _RetNone) or _is_missing(other):
+            return ("const", RET_NONE)
         raise Dy2StaticError(
             "this function returns a value on one path and bare "
             "`return`/None on another inside a traced `if`; a compiled "

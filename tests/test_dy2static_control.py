@@ -356,6 +356,18 @@ class TestBailErrors:
         with pytest.raises(Dy2StaticError, match="return structure"):
             g(_pos())
 
+    def test_all_bare_returns_traced_compiles_to_none(self):
+        # every path returns None — compiles, returns None (no error)
+        def f(x):
+            if x.sum() > 0:
+                return
+            z = (x * 2).sum()  # noqa: F841 — side computation only
+            return
+
+        g = paddle.jit.to_static(f)
+        assert g(_pos()) is None
+        assert g(_neg()) is None
+
     def test_bare_return_concrete_exact(self):
         def f(n):
             if n > 0:

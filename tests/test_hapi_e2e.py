@@ -103,7 +103,8 @@ class TestVisionModels:
         net = resnet18(num_classes=10)
         net.eval()
         x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
-        out = net(x)
+        with paddle.no_grad():
+            out = net(x)
         assert out.shape == [1, 10]
 
     @pytest.mark.slow

@@ -16,6 +16,7 @@ Three invariants, all default-on:
 import inspect
 import json
 import os
+import sys
 
 import pytest
 
@@ -154,21 +155,22 @@ def test_signatures_match_tracked_snapshot():
     assert os.path.exists(SNAPSHOT), (
         "docs/op_signatures.json missing — regenerate with "
         "`python tools/op_signatures.py`")
+    # use the GENERATOR's own extraction so the gate can never diverge
+    # from the snapshot format
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import op_signatures as gen
+
     with open(SNAPSHOT) as f:
         tracked = json.load(f)
+    live = gen.live_signatures()
     drift = []
-    for spec in ALL_SPECS:
-        fn = spec.resolve()
-        try:
-            live = str(inspect.signature(fn))
-        except (TypeError, ValueError):
-            live = "<builtin>"
-        t = tracked.get(spec.name)
+    for name, entry in live.items():
+        t = tracked.get(name)
         if t is None:
-            drift.append(f"{spec.name}: not in snapshot")
-        elif t["signature"] != live:
-            drift.append(
-                f"{spec.name}: live {live} != tracked {t['signature']}")
+            drift.append(f"{name}: not in snapshot")
+        elif t["signature"] != entry["signature"]:
+            drift.append(f"{name}: live {entry['signature']} != "
+                         f"tracked {t['signature']}")
     assert not drift, (
         "op signatures drifted from docs/op_signatures.json — if "
         "intentional, regenerate with `python tools/op_signatures.py`:\n"

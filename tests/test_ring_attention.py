@@ -49,7 +49,8 @@ class TestRingVsOracle:
     def test_dispatch_engages_ring_under_sep(self):
         mesh_mod.set_global_mesh(mesh_mod.hybrid_mesh(dp=2, sep=4))
         q, k, v = _qkv()
-        out = flash_attention(q, k, v, is_causal=True, dropout_p=0.0)
+        with paddle.no_grad():   # sharding check only — no backward
+            out = flash_attention(q, k, v, is_causal=True, dropout_p=0.0)
         # output sequence dim is sep-sharded — proof the ring path ran
         spec = out._value().sharding.spec
         assert "sep" in str(spec)

@@ -29,7 +29,8 @@ def test_forward_shapes(name, make, kw):
     paddle.seed(0)
     net = make(num_classes=10, **kw)
     net.eval()
-    out = net(_x())
+    with paddle.no_grad():   # shape check only — skip vjp tracing
+        out = net(_x())
     assert tuple(out.shape) == (1, 10), name
 
 
