@@ -331,6 +331,17 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return s[0]
 
+    def __iter__(self):
+        # without this, python falls back to the legacy __getitem__
+        # iteration protocol, which never terminates because jax clamps
+        # out-of-range indices instead of raising IndexError.  Validate
+        # the rank EAGERLY (plain method returning a generator), so
+        # iter(scalar) raises immediately like len() does.
+        s = self._value().shape
+        if not s:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(s[0]))
+
     def __hash__(self):
         return id(self)
 

@@ -259,6 +259,44 @@ class TestBreakContinue:
             assert g(n) == ref(n)
 
 
+class TestTensorIteration:
+    """`for row in tensor` (reference: Variable iteration / the loop
+    transformer's tensor-iterable handling).  Python's legacy getitem
+    iteration never terminates on jax's clamped indexing — Tensor
+    defines __iter__."""
+
+    def test_eager_iteration(self):
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        rows = list(t)
+        assert len(rows) == 3
+        np.testing.assert_allclose(rows[1].numpy(), [2.0, 3.0])
+
+    def test_traced_unrolls(self):
+        def f(x):
+            s = x[0] * 0.0
+            for row in x:
+                s = s + row * 2.0
+            return s
+
+        g = paddle.jit.to_static(f)
+        out = g(paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(3, 2)))
+        np.testing.assert_allclose(out.numpy(), [12.0, 18.0])
+
+    def test_zero_d_raises_eagerly(self):
+        t = paddle.to_tensor(np.float32(3.0))
+        with pytest.raises(TypeError, match="0-d"):
+            iter(t)
+
+    def test_enumerate_and_unpack(self):
+        t = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        acc = 0.0
+        for i, row in enumerate(t):
+            a, b = row
+            acc += i * float(a) + float(b)
+        assert acc == 0 * 0 + 1 + 1 * 2 + 3
+
+
 # ---------------------------------------------------------------------------
 # logical ops (reference test_logical.py)
 # ---------------------------------------------------------------------------
