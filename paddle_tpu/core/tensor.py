@@ -325,6 +325,15 @@ class Tensor:
     def __bool__(self):
         return bool(self._value())
 
+    def __format__(self, spec):
+        if not spec:
+            return str(self)
+        v = self._value()
+        if v.ndim == 0:
+            return format(v.item(), spec)
+        raise TypeError(
+            "format spec on a non-scalar Tensor; call .numpy() first")
+
     def __len__(self):
         s = self._value().shape
         if not s:

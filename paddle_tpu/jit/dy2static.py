@@ -272,6 +272,16 @@ def convert_logical_not(x):
     return not x
 
 
+def convert_ifexp(pred, t_fn, f_fn):
+    """``a if pred else b`` (reference: the ifelse transformer also
+    rewrites ternaries).  Concrete pred keeps python semantics exactly;
+    traced pred lowers both arms through the same branch unification as
+    statement `if`."""
+    out = convert_ifelse(pred, lambda: (t_fn(),), lambda: (f_fn(),),
+                         operands=(), names=("<ternary>",))
+    return out[0]
+
+
 def _logical_binop(op, x, y):
     xa = x._value() if isinstance(x, Tensor) else jnp.asarray(x)
     ya = y._value() if isinstance(y, Tensor) else jnp.asarray(y)
@@ -1177,16 +1187,19 @@ class _LogicalTransformer(ast.NodeTransformer):
     def visit_Lambda(self, node):
         return node
 
-    def visit_BoolOp(self, node: ast.BoolOp):
-        self.generic_visit(node)
+    @staticmethod
+    def _lambda_unsafe(*exprs) -> bool:
         # walrus bindings would become lambda-local (PEP 572) and
         # yield/await cannot live in a lambda at all — keep python
         # semantics for such operands
-        for v in node.values:
-            for n in ast.walk(v):
-                if isinstance(n, (ast.NamedExpr, ast.Yield, ast.YieldFrom,
-                                  ast.Await)):
-                    return node
+        return any(isinstance(n, (ast.NamedExpr, ast.Yield,
+                                  ast.YieldFrom, ast.Await))
+                   for e in exprs for n in ast.walk(e))
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        if self._lambda_unsafe(*node.values):
+            return node
         fname = ("convert_logical_and" if isinstance(node.op, ast.And)
                  else "convert_logical_or")
         expr = node.values[-1]
@@ -1204,6 +1217,15 @@ class _LogicalTransformer(ast.NodeTransformer):
             return ast.Call(func=_jst_attr("convert_logical_not"),
                             args=[node.operand], keywords=[])
         return node
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self.generic_visit(node)
+        if self._lambda_unsafe(node.body, node.orelse):
+            return node
+        self.changed = True
+        return ast.Call(func=_jst_attr("convert_ifexp"),
+                        args=[node.test, _lambda0(node.body),
+                              _lambda0(node.orelse)], keywords=[])
 
 
 class _CallSiteWrapper(ast.NodeTransformer):

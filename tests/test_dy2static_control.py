@@ -335,6 +335,23 @@ class TestLogical:
         g = convert_function(f)
         assert g() == ("fallback", "taken", True, False, 0)
 
+    def test_ternary_over_traced_pred(self):
+        def f(x):
+            return x * 2.0 if x.sum() > 0 else x * 3.0
+
+        g = paddle.jit.to_static(f)
+        np.testing.assert_allclose(g(_pos()).numpy(), 2.0)
+        np.testing.assert_allclose(g(_neg()).numpy(), -3.0)
+        assert len(g.program_cache) == 1
+
+    def test_ternary_concrete_value_semantics(self):
+        def f(n):
+            return "big" if n > 5 else "small"
+
+        g = convert_function(f)
+        assert g(10) == "big"
+        assert g(1) == "small"
+
     def test_chained_boolop(self):
         def f(x):
             if x.sum() > 0 and x.sum() < 10 and x.sum() != 5:
