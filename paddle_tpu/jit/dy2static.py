@@ -458,7 +458,36 @@ def _unify_slot(t, f, name, guard=False):
     ``guard`` marks a return-flag tail guard: every variable first
     assigned there is dead on the flag-set path (the function returns
     immediately after), so missing-side placeholders are always safe."""
-    t_missing, f_missing = _is_missing(t), _is_missing(f)
+    # "missing" = genuinely UNBOUND (UndefinedVar from an ld miss).  An
+    # explicit `None` binding is a VALUE for user variables — folding it
+    # away would silently override `x = None` defaults on the untaken
+    # path.  Only the generated return-value slots treat None as
+    # missing (their None init is the machinery's own placeholder).
+    def missing(v):
+        if isinstance(v, UndefinedVar):
+            return True
+        # generated slots initialize with None as the machinery's own
+        # placeholder; inside a return-flag guard every value is dead on
+        # the flag path, so None is equally placeholder-able there
+        return v is None and (guard or name.startswith(_GEN_PREFIX))
+
+    t_missing, f_missing = missing(t), missing(f)
+    if (t is None) != (f is None) and not (t_missing or f_missing):
+        other = f if t is None else t
+        if not _is_traced_val(other) and not isinstance(other, Tensor):
+            # two concrete python values (None vs e.g. a string): a
+            # traced condition cannot select between them
+            raise Dy2StaticError(
+                f"variable '{name}' is None on one path and a "
+                f"non-tensor value ({type(other).__name__}) on the "
+                "other of a converted `if` over a traced Tensor; a "
+                "compiled branch cannot select between python objects "
+                "— use tensor values on both paths")
+        raise Dy2StaticError(
+            f"variable '{name}' is None on one path of a converted "
+            "`if` over a traced Tensor and a tensor on the other; "
+            "assign a correctly-typed tensor default before the `if` "
+            "instead of None")
     if isinstance(t, _RetNone) or isinstance(f, _RetNone):
         # bare return on one side: compatible with another bare return or
         # with "not returned yet" (the value stays None either way), but
@@ -478,27 +507,26 @@ def _unify_slot(t, f, name, guard=False):
         present = f if t_missing else t
         leaves, treedef = jax.tree_util.tree_flatten(
             present, is_leaf=_is_leaf_obj)
-        specs = []
-        for lv in leaves:
-            if not _arrayable(lv):
-                if guard:
-                    # dead on the missing path — carry nothing, hand the
-                    # concrete object through unchanged
-                    return ("const", present)
-                raise Dy2StaticError(
-                    f"variable '{name}' is bound to a non-tensor value "
-                    f"({type(lv).__name__}) in one branch of a converted "
-                    "`if` over a traced Tensor and left unbound in the "
-                    "other; both branches must bind it")
-            arr_sh, arr_dt = _aval_of(lv)
-            specs.append((arr_sh, arr_dt))
-        if not guard and not name.startswith(_GEN_PREFIX):
-            raise Dy2StaticError(
-                f"variable '{name}' is assigned in only one branch of an "
-                "`if` whose condition is a traced Tensor; under static "
-                "conversion both branches must bind it — assign a "
-                "default before the `if`")
-        return ("tree", treedef, specs)
+        # a fully CONCRETE value (python scalar, list of constants, any
+        # object holding no trace-time tensors) bound in one branch only
+        # passes through as a constant — branch-local temps just work;
+        # python would only differ by NameError-ing on the untaken path
+        if not any(_is_traced_val(lv) for lv in leaves):
+            return ("const", present)
+        if guard:
+            if any(not _arrayable(lv) for lv in leaves):
+                return ("const", present)
+            return ("tree", treedef,
+                    [_aval_of(lv) for lv in leaves])
+        if name.startswith(_GEN_PREFIX) and \
+                all(_arrayable(lv) for lv in leaves):
+            return ("tree", treedef,
+                    [_aval_of(lv) for lv in leaves])
+        raise Dy2StaticError(
+            f"variable '{name}' is assigned a traced value in only one "
+            "branch of an `if` whose condition is a traced Tensor; under "
+            "static conversion both branches must bind it — assign a "
+            "default before the `if`")
     t_leaves, t_def = jax.tree_util.tree_flatten(t, is_leaf=_is_leaf_obj)
     f_leaves, f_def = jax.tree_util.tree_flatten(f, is_leaf=_is_leaf_obj)
     if t_def != f_def:
@@ -776,14 +804,53 @@ _BAIL_KEYWORD = {
 }
 
 
+# container-mutation methods that cannot cross a compiled region.
+# `add`/`sort`/`reverse` are deliberately absent: they collide with
+# (out-of-place) Tensor methods and would false-positive on `t.add(y)`
+_MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "setdefault", "discard", "popitem"})
+
+
+def _mutation_receiver(n):
+    """(root_name, dotted_receiver) when `n` is a mutating method call on
+    a name or attribute chain (buf.append / self.log.append), else
+    None."""
+    if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr in _MUTATING_METHODS):
+        return None
+    parts = []
+    root = n.func.value
+    while isinstance(root, ast.Attribute):
+        parts.append(root.attr)
+        root = root.value
+    if not isinstance(root, ast.Name):
+        return None
+    parts.append(root.id)
+    return root.id, ".".join(reversed(parts))
+
+
 def _bail_reason(stmts) -> Optional[str]:
     """Why this statement region cannot become a branch/loop-body
     function — None when it can."""
+    assigned = _assigned_names(stmts)
     for s in stmts:
         for n in _walk_stmt(s):
             if _nonname_store(n):
                 return ("it assigns into an attribute/subscript (object "
                         "mutation cannot cross a compiled branch)")
+            # list.append(...) etc. on a container from OUTSIDE the
+            # region (bare name or attribute chain like self.log): under
+            # tracing the call would run trace-count times (once per
+            # branch / once per loop), not run-count times — silently
+            # wrong sizes.  A container CREATED in the region is
+            # trace-local and fine.
+            recv = _mutation_receiver(n)
+            if recv is not None and recv[0] not in assigned:
+                return (f"it mutates `{recv[1]}` in place via "
+                        f".{n.func.attr}() — a python container cannot "
+                        "carry through a compiled branch/loop; collect "
+                        "into a Tensor instead")
             if isinstance(n, _BAIL_NODES):
                 # break/continue inside a NESTED loop are that loop's
                 # business, not ours
@@ -1189,12 +1256,19 @@ class _LogicalTransformer(ast.NodeTransformer):
 
     @staticmethod
     def _lambda_unsafe(*exprs) -> bool:
-        # walrus bindings would become lambda-local (PEP 572) and
-        # yield/await cannot live in a lambda at all — keep python
-        # semantics for such operands
-        return any(isinstance(n, (ast.NamedExpr, ast.Yield,
-                                  ast.YieldFrom, ast.Await))
-                   for e in exprs for n in ast.walk(e))
+        # walrus bindings would become lambda-local (PEP 572),
+        # yield/await cannot live in a lambda at all, and a container
+        # mutation (buf.pop()) would execute trace-count times under a
+        # traced predicate — keep python semantics (loud error when
+        # traced) for such operands
+        for e in exprs:
+            for n in ast.walk(e):
+                if isinstance(n, (ast.NamedExpr, ast.Yield,
+                                  ast.YieldFrom, ast.Await)):
+                    return True
+                if _mutation_receiver(n) is not None:
+                    return True
+        return False
 
     def visit_BoolOp(self, node: ast.BoolOp):
         self.generic_visit(node)

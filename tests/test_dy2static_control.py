@@ -462,6 +462,83 @@ class TestBailErrors:
         g = convert_function(f)
         assert g([1, 2]) == 5
 
+    def test_list_append_in_traced_loop_names_container(self):
+        # a python list cannot carry through a compiled loop; instead of
+        # appending once-per-trace (silently wrong length) the region
+        # bails and the error names the container and the method
+        def f(x):
+            acc = []
+            s = x.sum() * 0.0
+            while s.sum() < 3.0:
+                acc.append(1)
+                s = s + 1.0
+            return float(len(acc))
+
+        g = paddle.jit.to_static(f)
+        with pytest.raises(Dy2StaticError, match="acc.*append"):
+            g(paddle.to_tensor(np.zeros(1, np.float32)))
+
+    def test_list_created_inside_region_still_converts(self):
+        # a container CREATED in the branch is trace-local and fine
+        def f(x):
+            if x.sum() > 0:
+                parts = []
+                parts.append(2.0)
+                y = x * parts[0]
+            else:
+                y = x
+            return y
+
+        g = paddle.jit.to_static(f)
+        np.testing.assert_allclose(g(_pos()).numpy(), 2.0)
+        np.testing.assert_allclose(g(_neg()).numpy(), -1.0)
+
+    def test_explicit_none_default_not_folded(self):
+        # `x = None` before a traced one-sided assignment must never be
+        # silently overridden on the untaken path — named error instead
+        def f(x):
+            scale = None
+            if x.sum() > 0:
+                scale = 3.0
+            if scale is None:
+                scale = 1.0
+            return x * scale
+
+        g = paddle.jit.to_static(f)
+        with pytest.raises(Dy2StaticError, match="'scale'.*None"):
+            g(_neg())
+
+    def test_ternary_arm_mutation_stays_python(self):
+        buf = [1.0, 2.0, 3.0]
+
+        def f(x):
+            y = buf.pop() if x.sum() > 0 else 0.0
+            return x + y
+
+        g = paddle.jit.to_static(f)
+        with pytest.raises(Exception):
+            g(_neg())        # loud error, arms never execute
+        assert len(buf) == 3, "ternary arms ran at trace time"
+
+    def test_attribute_chain_append_bails_named(self):
+        class H:
+            def __init__(self):
+                self.log = []
+
+        h = H()
+
+        def f(x):
+            y = x
+            if x.sum() > 0:
+                h.log.append(1)
+                y = x * 2
+            return y
+
+        g = paddle.jit.to_static(f)
+        with pytest.raises(Dy2StaticError, match="h.log.*append"):
+            g(_pos())
+        assert h.log == [], "append ran at trace time"
+
     def test_yield_region_reported(self):
         def f(x):
             if x.sum() > 0:
