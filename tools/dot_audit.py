@@ -1,0 +1,70 @@
+"""Classify every dot_general of the compiled bench step by operand
+dtypes, contraction pattern, and shapes (backend-neutral StableHLO, so
+it runs with no TPU).  Round-5 findings recorded in docs/PERF.md:
+
+- all 436 dots take bf16xbf16 operands (4 accumulate to f32 outputs) —
+  AMP-O2 is airtight and the f32-epilogue hypothesis is refuted;
+- the dW family (c[0,1]x[0,1], 96 GEMMs contracting the 8192-token axis
+  of both operands) is the remaining layout-probe target for the 55%
+  MXU wall (tools/mxu_probe.py hypothesis #1);
+- attention shows unfused [8,16,1024,1024] score dots HERE because the
+  Pallas flash kernel only engages on TPU — on hardware those families
+  are replaced by the custom call.
+"""
+import collections
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+os.environ.setdefault("PADDLE_TPU_BENCH_AMP", "O2")
+
+import bench  # noqa: E402
+
+
+def main():
+    make_step, cfg, seq, model = bench.build_bench(smoke=False)
+    train_step, x, y = make_step(8)
+    prog = train_step.get_concrete_program(x, y)
+    state_arrays = [k.current() for k in prog.state_keys]
+    sd, sk = prog._split_state(state_arrays)
+    run = prog.jitted_donate if prog.donate else prog.jitted
+    txt = run.lower([x._value(), y._value()], sd, sk).as_text()
+
+    lines = [ln for ln in txt.splitlines() if "dot_general" in ln]
+    print("total dot_general lines:", len(lines))
+    pat = re.compile(
+        r"contracting_dims\s*=\s*\[([\d, ]*)\]\s*x\s*\[([\d, ]*)\].*?"
+        r":\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)\s*->"
+        r"\s*tensor<([^>]+)>")
+    counts = collections.Counter()
+    dtype_mix = collections.Counter()
+    unparsed = 0
+    for ln in lines:
+        m = pat.search(ln)
+        if not m:
+            unparsed += 1
+            continue
+        cl, cr, a, b, o = m.groups()
+        shape = lambda s: "x".join(s.split("x")[:-1])  # noqa: E731
+        dt = lambda s: s.split("x")[-1]                # noqa: E731
+        dtype_mix[f"{dt(a)}x{dt(b)}->{dt(o)}"] += 1
+        counts[(f"c[{cl}]x[{cr}]", shape(a), shape(b),
+                f"{dt(a)}x{dt(b)}->{dt(o)}")] += 1
+    print("unparsed:", unparsed)
+    print("\noperand/result dtype mix:")
+    for k, v in dtype_mix.most_common():
+        print(f"  {k}: {v}")
+    print(f"\nall {len(counts)} dot families (count, contraction, "
+          "lhs, rhs, dtypes):")
+    for (c, a, b, d), v in counts.most_common():
+        print(f"  {v:4d}x  {c:14s} lhs {a:18s} rhs {b:18s} {d}")
+
+
+if __name__ == "__main__":
+    main()
