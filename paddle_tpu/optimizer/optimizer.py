@@ -24,7 +24,45 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         self._learning_rate = learning_rate
-        self._parameter_list = list(parameters) if parameters is not None else None
+        # reference optimizer.py:91 accepts a flat Tensor list OR a list
+        # of group dicts ({'params': [...], 'learning_rate': factor,
+        # 'weight_decay'/'beta1'/...: per-group overrides}); group
+        # 'learning_rate' multiplies the global lr, like
+        # optimize_attr['learning_rate'] (_create_param_lr :566)
+        self._param_groups = None
+        self._param_overrides: Dict[int, dict] = {}
+        if parameters is not None:
+            plist = list(parameters)
+            if plist and isinstance(plist[0], dict):
+                flat: list = []
+                self._param_groups = []
+                seen = set()
+                for group in plist:
+                    g = dict(group)
+                    if "params" not in g:
+                        raise ValueError(
+                            "each optimizer parameter group dict needs a "
+                            f"'params' key; got keys {sorted(g)}")
+                    ps = g.get("params")
+                    ps = [ps] if isinstance(ps, Tensor) else list(ps)
+                    g["params"] = ps
+                    ov = {k: v for k, v in g.items() if k != "params"}
+                    for p in ps:
+                        if id(p) in seen:
+                            raise ValueError(
+                                "some parameters appear in more than one "
+                                "optimizer parameter group")
+                        seen.add(id(p))
+                        if ov:
+                            self._param_overrides[id(p)] = ov
+                        flat.append(p)
+                    self._param_groups.append(g)
+                self._parameter_list = flat
+            else:
+                self._parameter_list = plist
+        else:
+            self._parameter_list = None
+        self._lr_factor = 1.0
         self._grad_clip = grad_clip
         self._name = name
         self._regularizer = None
@@ -68,13 +106,19 @@ class Optimizer:
         from ..core import tensor as tensor_mod
 
         if isinstance(self._learning_rate, LRScheduler):
-            return self._learning_rate._lr_tensor()._value()
-        if tensor_mod._trace_hook is not None:
+            lr = self._learning_rate._lr_tensor()._value()
+        elif tensor_mod._trace_hook is not None:
             if self._lr_t is None:
                 self._lr_t = tensor_mod.external_tensor(
                     np.float32(self.get_lr()))
-            return self._lr_t._value()
-        return jnp.asarray(self.get_lr(), dtype=jnp.float32)
+            lr = self._lr_t._value()
+        else:
+            lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        if self._lr_factor != 1.0:
+            # per-group factor (reference optimize_attr['learning_rate'],
+            # applied as global_lr * param_lr in _create_param_lr :580)
+            lr = lr * jnp.float32(self._lr_factor)
+        return lr
 
     # -- accumulators -------------------------------------------------------
 
@@ -120,6 +164,36 @@ class Optimizer:
             out.append((p, g))
         return out
 
+    # attr <-> group-dict key pairs a group may override (reference
+    # _update_param_group in each optimizer subclass).  weight decay
+    # lives under different attrs per family: coupled `_weight_decay`
+    # (SGD/Momentum regularizer fold), decoupled `_wd` (AdamW/Lamb),
+    # `_lars_weight_decay` (Lars) — swap every one that exists.
+    _GROUP_OVERRIDE_ATTRS = (
+        ("_weight_decay", "weight_decay"), ("_wd", "weight_decay"),
+        ("_lars_weight_decay", "weight_decay"),
+        ("_beta1", "beta1"), ("_beta2", "beta2"),
+        ("_epsilon", "epsilon"), ("_momentum", "momentum"))
+
+    def _update_with_overrides(self, p, garr):
+        ov = self._param_overrides.get(id(p))
+        if not ov:
+            self._update_param(p, garr)
+            return
+        saved = {}
+        for attr, key in self._GROUP_OVERRIDE_ATTRS:
+            if key in ov and hasattr(self, attr):
+                saved[attr] = getattr(self, attr)
+                setattr(self, attr, ov[key])
+        if "learning_rate" in ov:
+            self._lr_factor = float(ov["learning_rate"])
+        try:
+            self._update_param(p, garr)
+        finally:
+            for attr, val in saved.items():
+                setattr(self, attr, val)
+            self._lr_factor = 1.0
+
     @no_grad()
     def step(self):
         params_grads = self._collect_params_grads()
@@ -130,7 +204,7 @@ class Optimizer:
             garr = g._value() if isinstance(g, Tensor) else g
             if garr.dtype in (jnp.bfloat16, jnp.float16):
                 garr = garr.astype(jnp.float32)
-            self._update_param(p, garr)
+            self._update_with_overrides(p, garr)
 
     minimize_step = step
 
