@@ -367,26 +367,37 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None):
+                 name=None, *, moment_dtype=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._multi_precision = multi_precision
+        # TPU extension (not in the reference API): store moment1/moment2 in
+        # a narrower dtype ("bfloat16") to halve optimizer HBM traffic —
+        # ~8 B/param/step saved; at 345M params that is ~2.8 GB/step off the
+        # AdamW update's ~9.7 GB.  The update math itself stays f32 (moments
+        # are widened on read, rounded on store).  bf16's 8 mantissa bits
+        # add ~0.4% relative noise to the moments; default stays f32.
+        self._moment_dtype = (None if moment_dtype is None
+                              else jnp.dtype(moment_dtype))
 
     def _adam_update(self, p, g, decoupled_wd=0.0):
         lr = self._lr_array()
-        m = self._get_accumulator("moment1", p, dtype=jnp.float32)
-        v = self._get_accumulator("moment2", p, dtype=jnp.float32)
+        mdt = self._moment_dtype or jnp.float32
+        m = self._get_accumulator("moment1", p, dtype=mdt)
+        v = self._get_accumulator("moment2", p, dtype=mdt)
         b1p = self._get_accumulator("beta1_pow", p, init=1.0, dtype=jnp.float32, shape=())
         b2p = self._get_accumulator("beta2_pow", p, init=1.0, dtype=jnp.float32, shape=())
         g32 = g.astype(jnp.float32)
-        m_new = self._beta1 * m._value() + (1 - self._beta1) * g32
-        v_new = self._beta2 * v._value() + (1 - self._beta2) * jnp.square(g32)
+        m_new = self._beta1 * m._value().astype(jnp.float32) \
+            + (1 - self._beta1) * g32
+        v_new = self._beta2 * v._value().astype(jnp.float32) \
+            + (1 - self._beta2) * jnp.square(g32)
         b1p_new = b1p._value() * self._beta1
         b2p_new = b2p._value() * self._beta2
-        m._set_data(m_new)
-        v._set_data(v_new)
+        m._set_data(m_new.astype(mdt))
+        v._set_data(v_new.astype(mdt))
         b1p._set_data(b1p_new)
         b2p._set_data(b2p_new)
         m_hat = m_new / (1.0 - b1p_new)
@@ -408,9 +419,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False, name=None,
+                 *, moment_dtype=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, multi_precision, name)
+                         None, grad_clip, lazy_mode, multi_precision, name,
+                         moment_dtype=moment_dtype)
         self._wd = float(weight_decay) if not hasattr(weight_decay, "_coeff") \
             else float(weight_decay._coeff)
         self._apply_decay_param_fun = apply_decay_param_fun

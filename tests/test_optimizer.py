@@ -75,6 +75,37 @@ class TestAdamNumerics:
         # zero grad → only decay applies: w *= (1 - lr*wd)
         np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.1 * 0.5)], rtol=1e-5)
 
+    def test_moment_dtype_bf16_tracks_f32(self):
+        """moment_dtype='bfloat16' (TPU HBM-traffic extension) stores the
+        moments narrow but must track the f32 optimizer's trajectory."""
+        import jax.numpy as jnp
+
+        def train(moment_dtype):
+            paddle.seed(0)
+            net = nn.Linear(4, 4)
+            o = opt.AdamW(0.05, parameters=net.parameters(),
+                          moment_dtype=moment_dtype)
+            xs = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+            w_true = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+            x = paddle.to_tensor(xs)
+            y = paddle.to_tensor(xs @ w_true)
+            for _ in range(20):
+                loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                o.step()
+                o.clear_grad()
+            return float(loss), o, net
+
+        loss32, _, net32 = train(None)
+        loss16, o16, net16 = train("bfloat16")
+        accs = next(iter(o16._accumulators.values()))
+        assert accs["moment1"]._value().dtype == jnp.bfloat16
+        assert accs["moment2"]._value().dtype == jnp.bfloat16
+        # trajectories agree to bf16 moment noise
+        np.testing.assert_allclose(loss16, loss32, rtol=0.05, atol=1e-3)
+        np.testing.assert_allclose(
+            net16.weight.numpy(), net32.weight.numpy(), rtol=0.05, atol=5e-3)
+
     def test_momentum_velocity(self):
         w = nn.Parameter(np.array([0.0], dtype=np.float32))
         o = opt.Momentum(learning_rate=1.0, momentum=0.5, parameters=[w])

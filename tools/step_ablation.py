@@ -82,30 +82,30 @@ def model_ablation():
     return results
 
 
-def flash_standalone():
+def make_flash_runners(block_q=None, block_k=None, B=8, S=1024, H=16, D=64):
+    """Jitted (run_fwd, run_bwd, q, k, v) timing harnesses for the Pallas
+    flash kernel at the bench shapes: iters-step scan with per-iteration
+    input perturbation (defeats CSE) and full-output sum|.| consumption
+    (defeats DCE — see mxu_probe).  Shared by step_ablation and
+    flash_sweep so the timing recipe cannot drift between tools."""
+    from functools import partial
+
     import jax
     import jax.numpy as jnp
     from paddle_tpu.ops.pallas.flash_attention_kernel import (
         flash_attention_fused)
 
-    B, S, H, D = 8, 1024, 16, 64
     key = jax.random.PRNGKey(0)
     q = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
 
-    from mxu_probe import slope_time
-
-    def slope(jfn, n_lo=10, n_hi=50):
-        return slope_time(lambda n: float(jfn(q, k, v, n)), n_lo, n_hi)
-
-    from functools import partial
-
     @partial(jax.jit, static_argnums=3)
     def run_fwd(q, k, v, iters):
         def body(c, i):
             o = flash_attention_fused(q + i.astype(q.dtype) * 1e-6, k, v,
-                                      causal=True)
+                                      causal=True, block_q=block_q,
+                                      block_k=block_k)
             return c + jnp.sum(jnp.abs(o.astype(jnp.float32))), ()
         acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
         return acc
@@ -113,7 +113,8 @@ def flash_standalone():
     @partial(jax.jit, static_argnums=3)
     def run_bwd(q, k, v, iters):
         def loss(q, k, v):
-            o = flash_attention_fused(q, k, v, causal=True)
+            o = flash_attention_fused(q, k, v, causal=True,
+                                      block_q=block_q, block_k=block_k)
             return jnp.sum(jnp.abs(o.astype(jnp.float32)))
 
         g = jax.grad(loss, argnums=(0, 1, 2))
@@ -126,6 +127,17 @@ def flash_standalone():
             return c + s, ()
         acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
         return acc
+
+    return run_fwd, run_bwd, q, k, v
+
+
+def flash_standalone():
+    from mxu_probe import slope_time
+
+    run_fwd, run_bwd, q, k, v = make_flash_runners()
+
+    def slope(jfn, n_lo=10, n_hi=50):
+        return slope_time(lambda n: float(jfn(q, k, v, n)), n_lo, n_hi)
 
     return {"flash_fwd_layer": slope(run_fwd),
             "flash_fwdbwd_layer": slope(run_bwd)}
