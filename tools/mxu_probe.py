@@ -139,7 +139,13 @@ def main():
     for name, a, b, c in SHAPES:
         orient = f"c{list(c[0])}x{list(c[1])}".replace(" ", "")
         for out_dtype, tag in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
-            dt, fs = bench_gemm(jax, jnp, a, b, c, out_dtype)
+            # one noisy shape must not abort a scarce hardware window
+            try:
+                dt, fs = bench_gemm(jax, jnp, a, b, c, out_dtype)
+            except RuntimeError as e:
+                print(f"{name:>10} {orient:>10} {tag:>8}  noise/err: {e}",
+                      flush=True)
+                continue
             print(f"{name:>10} {orient:>10} {tag:>8} {dt*1e3:>8.3f} "
                   f"{fs/1e12:>8.1f} {100*fs/peak:>5.1f}%", flush=True)
 
