@@ -272,6 +272,39 @@ def convert_logical_not(x):
     return not x
 
 
+_CHAIN_CMP_OPS = {
+    "Lt": lambda a, b: a < b, "LtE": lambda a, b: a <= b,
+    "Gt": lambda a, b: a > b, "GtE": lambda a, b: a >= b,
+    "Eq": lambda a, b: a == b, "NotEq": lambda a, b: a != b,
+    "Is": lambda a, b: a is b, "IsNot": lambda a, b: a is not b,
+    "In": lambda a, b: a in b, "NotIn": lambda a, b: a not in b,
+}
+
+
+def convert_chain_compare(left_fn, *pairs):
+    """``a OP1 b OP2 c ...`` with python's exact evaluation contract:
+    each operand evaluates AT MOST once, later operands are skipped after
+    a concrete-false comparison (short-circuit), and the false comparison
+    value itself is returned (python returns it, not ``False``).  Traced
+    comparisons fold with logical_and — the same semantic extension the
+    BoolOp converter applies."""
+    val = left_fn()
+    acc = None
+    for op, rhs_fn in pairs:
+        rhs = rhs_fn()
+        cmp = _CHAIN_CMP_OPS[op](val, rhs)
+        if acc is None:
+            acc = cmp
+        elif _is_traced_val(acc) or _is_traced_val(cmp):
+            acc = _logical_binop(jnp.logical_and, acc, cmp)
+        else:
+            acc = cmp
+        if not _is_traced_val(acc) and not _truthy(acc):
+            return acc
+        val = rhs
+    return acc
+
+
 def convert_ifexp(pred, t_fn, f_fn):
     """``a if pred else b`` (reference: the ifelse transformer also
     rewrites ternaries).  Concrete pred keeps python semantics exactly;
@@ -917,6 +950,13 @@ def _loaded_names(stmts) -> Set[str]:
         for n in _walk_stmt(s):
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
                 loads.add(n.id)
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Name):
+                # `y += 2` reads y even though the AST marks the target
+                # Store-only; missing it made the generated branch
+                # function treat y as an uninitialized local
+                # (UnboundLocalError at call time)
+                loads.add(n.target.id)
     return {n for n in loads if not n.startswith("__jst_")}
 
 
@@ -1291,6 +1331,30 @@ class _LogicalTransformer(ast.NodeTransformer):
             return ast.Call(func=_jst_attr("convert_logical_not"),
                             args=[node.operand], keywords=[])
         return node
+
+    def visit_Compare(self, node: ast.Compare):
+        """``a < b < c`` → ``_jst.convert_chain_compare(lambda: a,
+        ("Lt", lambda: b), ("Lt", lambda: c))`` so a chained comparison
+        over traced tensors converts like the explicit BoolOp would.
+        The runtime helper evaluates each operand AT MOST once and
+        short-circuits concrete-false comparisons, so python's chain
+        contract holds exactly even for impure operands; only
+        lambda-hostile operands (walrus/yield/mutation) stay python."""
+        self.generic_visit(node)
+        if len(node.ops) < 2:
+            return node
+        operands = [node.left] + node.comparators
+        if self._lambda_unsafe(*operands):
+            return node
+        pair_args = [
+            ast.Tuple(elts=[ast.Constant(type(op).__name__),
+                            _lambda0(operands[i + 1])],
+                      ctx=ast.Load())
+            for i, op in enumerate(node.ops)]
+        self.changed = True
+        return ast.Call(func=_jst_attr("convert_chain_compare"),
+                        args=[_lambda0(node.left)] + pair_args,
+                        keywords=[])
 
     def visit_IfExp(self, node: ast.IfExp):
         self.generic_visit(node)

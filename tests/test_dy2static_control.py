@@ -539,6 +539,78 @@ class TestBailErrors:
             g(_pos())
         assert h.log == [], "append ran at trace time"
 
+    def test_augassign_in_traced_branch(self):
+        # `y += 2` reads y: the branch function must take it as an input
+        # (regression: UnboundLocalError in the generated true-branch)
+        def f(x):
+            y = x * 1.0
+            if x.sum() > 0:
+                y += 2.0
+            return y
+
+        g = paddle.jit.to_static(f)
+        np.testing.assert_allclose(g(_pos()).numpy(), np.full(3, 3.0))
+        np.testing.assert_allclose(g(_neg()).numpy(), np.full(3, -1.0))
+
+    def test_augassign_under_break_flag_guard(self):
+        # `i += 1` below a traced break: the flag-guard if wraps it and
+        # must carry i through (regression: UnboundLocalError 'i')
+        def f(x):
+            i = 0
+            while i < 10:
+                x = x + 1.0
+                if x.sum() > 6.0:
+                    break
+                i += 1
+            return x.sum()
+
+        g = paddle.jit.to_static(f)
+        assert float(g(paddle.to_tensor(np.array([1., 2.],
+                                                 np.float32)))) == 7.0
+
+    def test_chained_comparison_traced(self):
+        def f(x):
+            s = x.sum()
+            if 0.0 < s < 10.0:
+                return s * 2.0
+            return s
+
+        g = paddle.jit.to_static(f)
+        assert float(g(_pos())) == 6.0                       # 0 < 3 < 10
+        big = paddle.to_tensor(np.full(3, 5.0, np.float32))
+        assert float(g(big)) == 15.0                         # 15 not < 10
+        assert float(g(_neg())) == -3.0                      # not 0 < -3
+
+    def test_chained_comparison_call_middle_evaluates_once(self):
+        # python's chain contract: a middle operand evaluates exactly
+        # once per pass, even when it is a call — the runtime converter
+        # must preserve this (the to_static fixed point may trace more
+        # than once, so compare against the and-chain equivalent's count)
+        calls = []
+
+        def probe():
+            calls.append(1)
+            return 3.0
+
+        def chained(x):
+            if 0.0 < probe() < 10.0:
+                return x * 2.0
+            return x
+
+        def explicit(x):
+            s = probe()
+            if 0.0 < s and s < 10.0:
+                return x * 2.0
+            return x
+
+        g = paddle.jit.to_static(chained)
+        np.testing.assert_allclose(g(_pos()).numpy(), np.full(3, 2.0))
+        chained_calls, calls = len(calls), []
+        g2 = paddle.jit.to_static(explicit)
+        np.testing.assert_allclose(g2(_pos()).numpy(), np.full(3, 2.0))
+        assert chained_calls == len(calls), \
+            "python chain must not re-evaluate its middle operand"
+
     def test_yield_region_reported(self):
         def f(x):
             if x.sum() > 0:
