@@ -204,12 +204,44 @@ _SKIP_CALL_MODULES = ("paddle_tpu", "jax", "numpy", "builtins",
                       "functools", "itertools", "operator", "np")
 
 
+def _traced_scalar(v):
+    return (_is_traced_val(v)
+            and tuple(getattr(v, "shape", (None,))) == ())
+
+
+def _convert_minmax(builtin, fold):
+    """``max(a, b, ...)``/``min`` with traced SCALAR tensor args: python
+    would bool() a comparison of tracers — fold elementwise instead
+    (exact for scalars; reference convert_call maps builtins too).
+    Every other form — single-iterable, key=/default=, non-scalar or
+    fully concrete args — keeps the builtin (eager semantics, loud
+    errors included)."""
+    def wrapped(*args, **kwargs):
+        if (not kwargs and len(args) >= 2
+                and any(_traced_scalar(a) for a in args)
+                and all(_arrayable(a) for a in args)
+                and all(tuple(getattr(a, "shape", ())) == ()
+                        for a in args)):
+            acc = args[0]
+            for a in args[1:]:
+                acc = _logical_binop(fold, acc, a)
+            return acc
+        return builtin(*args, **kwargs)
+    return wrapped
+
+
 def convert_call(fn):
     """Recursively convert plain USER functions reached from converted
     code (reference: convert_call wrapping every call site,
     dygraph_to_static/convert_call_func.py).  Library code (paddle_tpu /
     jax / numpy / builtins) is never touched — it has no tensor-dependent
-    python control flow by construction."""
+    python control flow by construction.  Exceptions: ``max``/``min``,
+    whose python semantics bool() tracer comparisons (mapped to exact
+    scalar folds above)."""
+    if fn is max:
+        return _convert_minmax(max, jnp.maximum)
+    if fn is min:
+        return _convert_minmax(min, jnp.minimum)
     try:
         import inspect
 
@@ -644,33 +676,42 @@ def _promote_loop_vars(vars_):
 def _check_loop_carry(names, vars_, probe):
     """A tensor-dependent loop carries a fixed structure: a var that is
     None/unbound at entry but becomes a Tensor inside the body would be
-    silently dropped by lax.while_loop — catch it with a named error
-    instead.  `probe` abstractly evaluates the body; probe failures are
-    ignored (the real trace will surface them with context)."""
+    silently dropped by lax.while_loop — catch it with a named error.
+    EXCEPTIONS: the generated return-value slot (``__jstf_val_*``) is
+    dead until its flag is set, and the flag-setting iteration always
+    assigns it — fill it with a placeholder of the probed shape/dtype so
+    early `return` inside a tensor-dependent loop compiles (the same
+    dead-slot argument convert_ifelse applies to one-sided returns).
+    The for-range shadow target (``__jstf_tgt_*``) likewise starts
+    unbound when the loop target was never pre-bound; the range
+    machinery already overshoot-corrects an unbound target after the
+    loop, so a placeholder is equally unobservable.
+    `probe` abstractly evaluates the body; probe failures are ignored
+    (the real trace will surface them with context).  Returns ``vars_``,
+    possibly with placeholders filled."""
     if names is None:
-        return
+        return vars_
     missing = [i for i, v in enumerate(vars_) if _is_missing(v)]
     if not missing:
-        return
+        return vars_
     try:
         outs = probe()
     except Exception:
-        return
+        return vars_
+    vars_ = list(vars_)
     for i in missing:
         if i < len(outs) and isinstance(outs[i], Tensor):
             nm = names[i]
-            if nm.startswith(_GEN_PREFIX + "val"):
-                raise Dy2StaticError(
-                    "early `return` inside a loop whose trip count "
-                    "depends on a traced Tensor is not supported: the "
-                    "return value has no defined type before the first "
-                    "iteration. Assign the result to a variable "
-                    "initialized before the loop and return it after.")
+            if nm.startswith((_GEN_PREFIX + "val", _GEN_PREFIX + "tgt")):
+                a = outs[i]._value()     # abstract: shape/dtype readable
+                vars_[i] = Tensor._wrap(jnp.zeros(a.shape, a.dtype))
+                continue
             raise Dy2StaticError(
                 f"loop variable '{nm}' enters a tensor-dependent loop "
                 "unbound (or None) but is assigned a Tensor inside the "
                 "body; initialize it with a correctly-shaped tensor "
                 "before the loop so the compiled loop can carry it")
+    return vars_
 
 
 # abstract body probe: identical contract to the branch probe — one
@@ -685,7 +726,8 @@ def convert_while(cond_fn, body_fn, init_vars, names=None):
 
     def _lower(vars_):
         vars_ = _promote_loop_vars(vars_)
-        _check_loop_carry(names, vars_, lambda: _probe_body(body_fn, vars_))
+        vars_ = _check_loop_carry(
+            names, vars_, lambda: _probe_body(body_fn, vars_))
         return tuple(while_loop(cond_fn, body_fn, vars_))
 
     vars_ = list(init_vars)
@@ -723,10 +765,16 @@ def convert_range_loop(start, stop, step, body_fn, init_vars, names=None,
 
     bounds = [start, stop, step]
     if any(_is_traced(b) for b in bounds):
-        _check_loop_carry(
+        # probe with a TRACED index: in the lowered loop the index is a
+        # carried Tensor, so anything assigned from it (the break-shadow
+        # target in particular) comes out traced — a concrete probe
+        # index would under-report that and leave the carry unfixable
+        start_t = start if isinstance(start, Tensor) else Tensor._wrap(
+            jnp.asarray(start))
+        init_vars = _check_loop_carry(
             names, list(init_vars),
-            lambda: _probe_body(lambda *vs: body_fn(start, *vs),
-                                list(init_vars)))
+            lambda: _probe_body(lambda i0, *vs: body_fn(i0, *vs),
+                                [start_t] + list(init_vars)))
     if not any(_is_traced(b) for b in bounds):
         vars_ = tuple(init_vars)
         tgt = target_init
@@ -944,6 +992,17 @@ def _assigned_names(stmts) -> Set[str]:
     return {n for n in names if not n.startswith("__jst_")}
 
 
+def _is_converted_unpack(n) -> bool:
+    """An Assign generated by an earlier (innermost-first) conversion:
+    ``b, = _jst.convert_ifelse(...)`` / ``convert_while`` / range-loop."""
+    return (isinstance(n, ast.Assign)
+            and isinstance(n.value, ast.Call)
+            and isinstance(n.value.func, ast.Attribute)
+            and n.value.func.attr.startswith("convert_")
+            and isinstance(n.value.func.value, ast.Name)
+            and n.value.func.value.id == "_jst")
+
+
 def _loaded_names(stmts) -> Set[str]:
     loads: Set[str] = set()
     for s in stmts:
@@ -957,6 +1016,15 @@ def _loaded_names(stmts) -> Set[str]:
                 # function treat y as an uninitialized local
                 # (UnboundLocalError at call time)
                 loads.add(n.target.id)
+            elif _is_converted_unpack(n):
+                # outputs of an inner converted construct READ their
+                # pre-value on the untaken/zero-trip side — but the read
+                # sits inside the generated branch funcdefs, which are
+                # scope barriers this walk rightly skips.  Count the
+                # targets as reads so an enclosing conversion passes the
+                # pre-value in as a parameter (else python shadows it
+                # and the inner thunk sees an unbound local).
+                loads.update(_assigned_names([n]))
     return {n for n in loads if not n.startswith("__jst_")}
 
 
@@ -1024,13 +1092,18 @@ def _contains_return(s) -> bool:
 def _always_returns(stmts) -> bool:
     """Conservative terminal-path analysis: True when every way out of
     this statement list is a `return` or `raise` (loops are assumed
-    skippable, so they never count)."""
+    skippable, so they never count — EXCEPT ``while True`` without a
+    break, which python can only leave by returning/raising)."""
     for s in stmts:
         if isinstance(s, (ast.Return, ast.Raise)):
             return True
         if isinstance(s, ast.If) and s.orelse:
             if _always_returns(s.body) and _always_returns(s.orelse):
                 return True
+        if (isinstance(s, ast.While) and isinstance(s.test, ast.Constant)
+                and s.test.value and not s.orelse
+                and not _owned_bc(s.body)[0]):
+            return True
     return False
 
 

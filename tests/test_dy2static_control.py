@@ -123,7 +123,9 @@ class TestEarlyReturn:
         g = convert_function(ret_bare)
         np.testing.assert_allclose(g(_pos()).numpy(), 2.0)
 
-    def test_return_in_traced_loop_raises_named(self):
+    def test_return_in_traced_loop(self):
+        # the generated return-value slot joins the lax.while_loop carry
+        # as a dead-until-flag placeholder of the probed shape/dtype
         def f(x):
             s = x * 0.0
             while s.sum() < 10.0:
@@ -133,8 +135,55 @@ class TestEarlyReturn:
             return s
 
         g = paddle.jit.to_static(f)
-        with pytest.raises(Dy2StaticError, match="return.*inside a loop"):
-            g(_pos())
+        for arr in ([1., 1., 1.], [4., 4., 4.], [0.5, 0.5, 0.5]):
+            x = paddle.to_tensor(np.asarray(arr, np.float32))
+            want = f(x).numpy()          # eager oracle
+            np.testing.assert_allclose(g(x).numpy(), want)
+
+    def test_return_in_traced_range_loop(self):
+        # the break-shadow target joins the carry via the traced-index
+        # probe (regression: IndexError from a carry-structure mismatch)
+        def f(x):
+            n = (x.sum() * 0 + 5).astype('int32')
+            s = x * 0.0
+            for i in range(n):
+                s = s + 1.0
+                if s.sum() > 3.0:
+                    return s * 2.0
+            return s
+
+        g = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.array([1., 1.], np.float32))
+        np.testing.assert_allclose(g(x).numpy(), np.full(2, 4.0))
+
+    def test_return_in_zero_trip_traced_range(self):
+        def f(x):
+            n = (x.sum() * 0).astype('int32')      # zero iterations
+            s = x * 0.0
+            for i in range(n):
+                s = s + 1.0
+                if s.sum() > 0:
+                    return s * 100.0
+            return s + 7.0
+
+        g = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.array([1., 1.], np.float32))
+        np.testing.assert_allclose(g(x).numpy(), np.full(2, 7.0))
+
+    def test_while_true_only_exit_is_return(self):
+        # `while True` with no break never falls through: the function
+        # compiles with an unconditional return tail (regression: a
+        # misleading falls-off-the-end error)
+        def f(x):
+            s = x * 0.0
+            while True:
+                s = s + 1.0
+                if s.sum() > 5.0:
+                    return s * 2.0
+
+        g = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.array([1., 1.], np.float32))
+        np.testing.assert_allclose(g(x).numpy(), np.full(2, 6.0))
 
 
 # ---------------------------------------------------------------------------
@@ -610,6 +659,35 @@ class TestBailErrors:
         np.testing.assert_allclose(g2(_pos()).numpy(), np.full(3, 2.0))
         assert chained_calls == len(calls), \
             "python chain must not re-evaluate its middle operand"
+
+    def test_nested_if_prebound_var_unifies_with_outer(self):
+        # `b = default; if c1: ...; if c2: b = ...` — the inner converted
+        # if's outputs are reads of the enclosing branch (the pre-value
+        # flows in as a parameter; regression: one-sided-assignment error
+        # despite the pre-binding)
+        def f(x):
+            a = x * 0.0
+            b = x * 0.0
+            if x.sum() > 0:
+                a = x + 1.0
+                if x.sum() > 2:
+                    b = x + 2.0
+            return (a + b).sum()
+
+        g = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.array([1., 2.], np.float32))
+        assert float(g(x)) == 12.0        # a=[2,3], b=[3,4]
+        small = paddle.to_tensor(np.array([0.5, 0.5], np.float32))
+        assert float(g(small)) == 3.0     # inner untaken: b stays 0
+        assert float(g(_neg())) == 0.0    # outer untaken
+
+    def test_minmax_builtin_on_traced_scalars(self):
+        def f(x):
+            return max(x.sum(), x.sum() * 2.0) + min(x.sum(), -1.0)
+
+        g = paddle.jit.to_static(f)
+        assert float(g(_pos())) == 5.0    # max(3,6)=6,  min(3,-1)=-1
+        assert float(g(_neg())) == -6.0   # max(-3,-6)=-3, min(-3,-1)=-3
 
     def test_yield_region_reported(self):
         def f(x):
