@@ -64,11 +64,23 @@ class TapeNode:
     refs to differentiable input Tensors and to output Tensors (cycle is
     collected by the python GC once user refs drop)."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "released",
-                 "materialize", "input_edges", "__weakref__")
+    __slots__ = ("vjp_fn", "primal_fn", "input_arrays", "inputs", "outputs",
+                 "name", "released", "materialize", "input_edges",
+                 "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, outputs, name="", materialize=True):
+    def __init__(self, vjp_fn, inputs, outputs, name="", materialize=True,
+                 primal_fn=None, input_arrays=None):
         self.vjp_fn = vjp_fn
+        # pure function of the diff inputs' ARRAYS (non-diff args baked),
+        # kept so grad(create_graph=True) can replay the subgraph as one
+        # differentiable jax function — the stored vjp closure alone bakes
+        # the primals in, which would silently zero d²/dprimal² terms
+        self.primal_fn = primal_fn
+        # the diff inputs' arrays AT RECORD TIME: replay must agree with
+        # the first-order path even if a leaf was in-place mutated after
+        # the forward (vjp residuals captured the old values; reading
+        # t._value() at grad time would silently use the new ones)
+        self.input_arrays = input_arrays
         self.inputs: List[Any] = inputs  # Tensors (diff inputs only)
         self.outputs: List[Any] = outputs  # Tensors produced
         self.name = name
@@ -86,6 +98,8 @@ class TapeNode:
 
     def release(self):
         self.vjp_fn = None
+        self.primal_fn = None
+        self.input_arrays = None
         self.released = True
 
 
@@ -206,6 +220,130 @@ def _accum(cot: dict, keep: dict, t, g):
     keep[id(t)] = t
 
 
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """``paddle.grad(..., create_graph=True)``: higher-order-capable grads.
+
+    The stored per-node vjp closures bake the primal values in, so
+    differentiating THROUGH them would silently drop every d²y/dx² term
+    that flows via the primals.  Instead the recorded subgraph between
+    ``inputs`` and ``outputs`` is REPLAYED as one pure jax function of
+    the input arrays (each TapeNode keeps its primal_fn for exactly
+    this), and its jax.vjp runs through the normal op dispatch — the
+    returned grads therefore carry a fresh tape node and are themselves
+    differentiable to any order.  Implies retain_graph (nothing is
+    released).  Reference: eager double-grad tests
+    (test_imperative_double_grad.py) / GradNodeBase higher-order path."""
+    from .dispatch import apply_op
+    from .tensor import Tensor
+
+    # collect the full ancestry (forward topological order)
+    order: List[TapeNode] = []
+    seen = set()
+    for t in outputs:
+        n = getattr(t, "_grad_node", None)
+        if n is None:
+            continue
+        if n.released:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time "
+                "(set retain_graph=True if you need to).")
+        for nd in _toposort(n):
+            if id(nd) not in seen:
+                seen.add(id(nd))
+                order.append(nd)
+    for nd in order:
+        for t in nd.inputs:
+            up = getattr(t, "_grad_node", None)
+            if up is not None and up.released:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time "
+                    "(set retain_graph=True if you need to).")
+
+    in_ids = {id(t) for t in inputs}
+    # prune to nodes DOWNSTREAM of a requested input: anything upstream
+    # of every cut point contributes nothing to the grads (its outputs
+    # are either seeds or record-time constants), so it is neither
+    # replayed nor required to have a replayable primal
+    live_ids = set(in_ids)
+    live: List[TapeNode] = []
+    for nd in order:
+        if any(id(t) in live_ids for t in nd.inputs):
+            live.append(nd)
+            live_ids.update(id(o) for o in nd.outputs)
+    for nd in live:
+        if nd.primal_fn is None:
+            raise NotImplementedError(
+                f"create_graph=True through op '{nd.name}' (a PyLayer) "
+                "is not supported: it has no replayable primal")
+
+    # connectivity for allow_unused: every live node is an ancestor of
+    # the outputs (order is the outputs' ancestry) and seed-crossing
+    # paths still flow, so consumption by a live node means connected
+    out_ids = {id(o) for o in outputs}
+    consumed_by_live = {id(t2) for nd in live for t2 in nd.inputs}
+    reachable = [id(t) in consumed_by_live or id(t) in out_ids
+                 for t in inputs]
+    if not allow_unused and not all(reachable):
+        raise RuntimeError(
+            "One of the differentiated tensors appears unused; pass "
+            "allow_unused=True to return None for it.")
+
+    # record-time arrays for every node input (first-order backward uses
+    # the vjp residuals captured at forward time; replay must agree even
+    # if a leaf was mutated in place since)
+    recorded: Dict[int, Any] = {}
+    for nd in order:
+        if nd.input_arrays is not None:
+            for t, a in zip(nd.inputs, nd.input_arrays):
+                recorded.setdefault(id(t), a)
+
+    def replay(*in_arrays):
+        seeds = {id(t): a for t, a in zip(inputs, in_arrays)}
+        env: Dict[int, Any] = dict(seeds)
+        for nd in live:
+            args = [env.get(id(t), recorded.get(id(t), t._value()))
+                    for t in nd.inputs]
+            outs = nd.primal_fn(*args)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for o, a in zip(nd.outputs, outs):
+                if id(o) in seeds:
+                    # a requested input that is ALSO produced in-graph:
+                    # both grads must flow — d/dseed sees the direct
+                    # cotangent, d/dupstream flows through the producer.
+                    # value: a + seed - stop_grad(seed) == a (the seed is
+                    # the recorded value of this very tensor)
+                    s = seeds[id(o)]
+                    env[id(o)] = a + (s - jax.lax.stop_gradient(s))
+                else:
+                    env[id(o)] = a
+        return tuple(env.get(id(t), recorded.get(id(t), t._value()))
+                     for t in outputs)
+
+    n_in = len(inputs)
+    cts = []
+    for t, g in zip(outputs,
+                    grad_outputs or [None] * len(outputs)):
+        if g is None:
+            cts.append(Tensor._wrap(jnp.ones(t.shape, dtype=t.dtype),
+                                    stop_gradient=True))
+        else:
+            cts.append(g if isinstance(g, Tensor)
+                       else Tensor._wrap(jnp.asarray(g)))
+
+    def hi_primal(*arrs):
+        xs, ct_arrs = arrs[:n_in], arrs[n_in:]
+        _, vjp = jax.vjp(replay, *xs)
+        grads = vjp(tuple(ct_arrs))
+        # single-output primals must return a bare array: the tape's
+        # backward feeds a matching bare cotangent to this node's vjp
+        return grads if n_in > 1 else grads[0]
+
+    res = apply_op("grad_replay", hi_primal, [*inputs, *cts],
+                   n_outs=n_in)
+    res = res if isinstance(res, tuple) else (res,)
+    return [r if ok else None for r, ok in zip(res, reachable)]
+
+
 def grad(
     outputs,
     inputs,
@@ -223,9 +361,8 @@ def grad(
     if isinstance(inputs, Tensor):
         inputs = [inputs]
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.incubate.autograd for higher-order"
-        )
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
     # Save/restore raw grad payloads so we can reuse the accumulation path.
     saved = [t._grad for t in inputs]
     saved_sg = [t.stop_gradient for t in inputs]

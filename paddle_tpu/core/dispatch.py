@@ -108,6 +108,8 @@ def apply_op(
         inputs=[tensor_args[i] for i in diff_idx],
         outputs=outs_list,
         name=name,
+        primal_fn=_primal_on_diff,
+        input_arrays=[arrays[i] for i in diff_idx],
     )
     for t in outs_list:
         t._grad_node = node

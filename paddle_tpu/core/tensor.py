@@ -164,21 +164,27 @@ class Tensor:
         if h is not None:
             h.mark_created(self)
 
+    def _init_fields(self, stop_gradient=True, name=None):
+        """Initialize every non-payload slot (shared by _wrap, detach and
+        any other raw __new__ construction — keep in sync with __slots__
+        so no construction path leaves a slot unset)."""
+        self._grad = None
+        self._grad_node = None
+        self.stop_gradient = stop_gradient
+        self.name = name or ""
+        self.persistable = False
+        self.trainable = True
+        self._version = 0
+        self._backward_hooks = None
+        self._trace_born = None
+        self._trace_grad = None
+        self._consumers = None
+
     @staticmethod
     def _wrap(arr, stop_gradient=True, name=None) -> "Tensor":
         t = Tensor.__new__(Tensor)
         t._data = arr
-        t._grad = None
-        t._grad_node = None
-        t.stop_gradient = stop_gradient
-        t.name = name or ""
-        t.persistable = False
-        t.trainable = True
-        t._version = 0
-        t._backward_hooks = None
-        t._trace_born = None
-        t._trace_grad = None
-        t._consumers = None
+        t._init_fields(stop_gradient=stop_gradient, name=name)
         h = _trace_hook
         if h is not None:
             h.mark_created(t)
@@ -360,7 +366,20 @@ class Tensor:
         autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
 
     def detach(self) -> "Tensor":
-        return Tensor._wrap(self._value(), stop_gradient=True, name=self.name)
+        """A tensor SHARING this tensor's storage with autograd cut off
+        (reference semantics: detach returns a view — writes through
+        either alias are visible to both; `dense_tensor.h:63`
+        shallow-copy sharing).  Implemented as a view object delegating
+        its payload to the base tensor, since jax arrays are immutable
+        and "storage" here is the rebindable payload slot."""
+        base = self._base if isinstance(self, _DetachedView) else self
+        v = _DetachedView.__new__(_DetachedView)
+        v._base = base
+        v._init_fields(stop_gradient=True, name=self.name)
+        h = _trace_hook
+        if h is not None:
+            h.mark_created(v)
+        return v
 
     def detach_(self) -> "Tensor":
         self._grad_node = None
@@ -536,6 +555,38 @@ class Tensor:
 
     # astype / math dunders etc. are attached by paddle_tpu.ops at import
     # time via register_tensor_method().
+
+
+class _DetachedView(Tensor):
+    """detach() result: shares the base tensor's payload slot (reference:
+    detach returns a storage-sharing view) with its own autograd state.
+
+    The ``_data`` property shadows the base-class slot so EVERY consumer
+    — including code reading ``t._data`` directly — sees the base's
+    current payload; writes through either alias are visible to both.
+    ``_value``/``_set_data`` route through the base so trace-time reads
+    and writes carry the BASE identity (the tracer knows the base, not
+    the view).  One divergence from the reference: a write through the
+    view does not bump the base's inplace version, so a stale-backward
+    through earlier consumers computes with their captured pre-write
+    residuals instead of raising — values are correct either way."""
+
+    __slots__ = ("_base",)
+
+    @property
+    def _data(self):
+        return self._base._data
+
+    @_data.setter
+    def _data(self, arr):
+        self._base._data = arr
+
+    def _value(self):
+        return self._base._value()
+
+    def _set_data(self, arr):
+        self._base._set_data(arr)
+        self._version += 1
 
 
 def _is_tracer(x) -> bool:

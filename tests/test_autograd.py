@@ -137,3 +137,147 @@ def test_setitem_grad_flows_to_value():
     x[1] = v
     x.sum().backward()
     np.testing.assert_allclose(v.grad.numpy(), [1.0])
+
+
+# ---------------------------------------------------------------------------
+# higher-order: paddle.grad(create_graph=True) replays the tape subgraph as
+# one differentiable jax function (reference: test_imperative_double_grad.py)
+# ---------------------------------------------------------------------------
+
+def test_double_and_triple_backward():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)     # 3x^2
+    (g2,) = paddle.grad(g, x, create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)    # 6x
+    (g3,) = paddle.grad(g2, x)
+    np.testing.assert_allclose(g3.numpy(), [6.0], rtol=1e-6)     # 6
+
+
+def test_gradient_penalty_backward():
+    # the canonical WGAN-GP pattern: ||dL/dx||^2 minimized via backward()
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    y = (x ** 2).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    penalty = (gx * gx).sum()                    # 4x^2 → d/dx = 8x
+    penalty.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 16.0], rtol=1e-5)
+
+
+def test_create_graph_allow_unused_and_intermediate():
+    a = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    ga, gb = paddle.grad(a * 3, [a, b], create_graph=True,
+                         allow_unused=True)
+    assert gb is None
+    np.testing.assert_allclose(ga.numpy(), [3.0], rtol=1e-6)
+
+    # grads w.r.t. an intermediate treat it as the cut point
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    h = x * x
+    z = h * h
+    (gh,) = paddle.grad(z, h, create_graph=True)
+    np.testing.assert_allclose(gh.numpy(), [8.0], rtol=1e-6)     # 2h
+
+
+def test_second_order_nonlinear():
+    import math
+
+    x = paddle.to_tensor(np.array([0.5], np.float32), stop_gradient=False)
+    y = paddle.sin(x) * paddle.exp(x)
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1, x)
+    want = 2 * math.cos(0.5) * math.exp(0.5)
+    np.testing.assert_allclose(g2.numpy(), [want], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# detach storage sharing (reference: detach returns a view of the same
+# storage — dense_tensor.h:63 shallow-copy semantics)
+# ---------------------------------------------------------------------------
+
+def test_detach_shares_storage_both_ways():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    d = x.detach()
+    d[0] = 5.0
+    assert float(x[0]) == 5.0
+    x[1] = 9.0
+    assert float(d[1]) == 9.0
+    dd = d.detach()            # view-of-view shares the same root
+    dd[0] = 7.0
+    assert float(x[0]) == 7.0
+    np.testing.assert_allclose(d.numpy(), x.numpy())
+
+
+def test_detach_cuts_autograd_but_shares_value():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * 3
+    d = y.detach()
+    assert d.stop_gradient
+    (y * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    # the detached view still reads y's current payload
+    np.testing.assert_allclose(d.numpy(), y.numpy())
+
+
+def test_detach_under_to_static_reads_base():
+    @paddle.jit.to_static
+    def f(a):
+        b = a.detach()
+        return (b * 2 + a).sum()
+
+    out = f(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    assert float(out) == 9.0
+
+
+def test_create_graph_leaf_and_intermediate_together():
+    # both grads flow: the intermediate seed uses a + (s - stop_grad(s))
+    # so d/dseed sees the direct cotangent while d/dleaf flows through
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    h = x * x
+    z = h * h
+    gx, gh = paddle.grad(z, [x, h], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [32.0], rtol=1e-5)   # 4x^3
+    np.testing.assert_allclose(gh.numpy(), [8.0], rtol=1e-5)    # 2h
+
+
+def test_create_graph_uses_record_time_values():
+    # replay must agree with the first-order path (vjp residuals) even
+    # after an in-place mutation of another leaf
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    w = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * w
+    w.set_value(np.array([5.0], np.float32))
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0], rtol=1e-6)
+
+
+def test_create_graph_released_graph_raises_retain_error():
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        paddle.grad(y, x, create_graph=True)
+
+
+def test_create_graph_pylayer_upstream_of_cut():
+    # a PyLayer strictly upstream of the requested input is pruned, not
+    # a NotImplementedError
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    h = Double.apply(x)
+    z = (h * h).sum()
+    (gh,) = paddle.grad(z, h, create_graph=True)
+    np.testing.assert_allclose(gh.numpy(), [8.0], rtol=1e-5)
