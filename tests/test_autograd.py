@@ -281,3 +281,17 @@ def test_create_graph_pylayer_upstream_of_cut():
     z = (h * h).sum()
     (gh,) = paddle.grad(z, h, create_graph=True)
     np.testing.assert_allclose(gh.numpy(), [8.0], rtol=1e-5)
+
+
+def test_create_graph_under_to_static():
+    # compiled gradient-penalty: the replayed higher-order grad traces
+    # into the same XLA program
+    @paddle.jit.to_static
+    def f(x):
+        x.stop_gradient = False
+        y = (x ** 2).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        return (gx * gx).sum()
+
+    out = f(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(float(out), 20.0, rtol=1e-5)  # sum (2x)^2
