@@ -117,13 +117,20 @@ class Model:
         return [loss_val] if loss_val is not None else []
 
     def predict_batch(self, inputs):
+        """Run one inference batch; returns a LIST of numpy arrays, one
+        per network output (reference `hapi/model.py:811-820`
+        predict_batch returns `[to_numpy(o) for o in to_list(outputs)]`
+        — a list even for a single output)."""
         self.network.eval()
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         ins = [x if isinstance(x, Tensor) else to_tensor(x) for x in ins]
         from ..core.autograd import no_grad
 
         with no_grad():
-            return self.network(*ins)
+            out = self.network(*ins)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o.numpy()) if isinstance(o, Tensor)
+                else np.asarray(o) for o in outs]
 
     # -- loops ----------------------------------------------------------------
 
@@ -225,22 +232,17 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, verbose=1, callbacks=None):
+        """Reference contract (`hapi/model.py:2005-2017`): returns a list
+        with ONE entry per network output; each entry is the list of
+        per-batch arrays, or one vstacked array when ``stack_outputs``."""
         loader = self._make_loader(test_data, batch_size, False)
         outputs = []
         for batch in loader:
             x, _ = self._unpack(batch)
-            out = self.predict_batch(x)
-            outputs.append(out)
-        if stack_outputs and outputs:
-            first = outputs[0]
-            if isinstance(first, (list, tuple)):
-                outputs = [
-                    np.concatenate([np.asarray(o[i].numpy()) for o in outputs])
-                    for i in range(len(first))
-                ]
-            else:
-                outputs = np.concatenate(
-                    [np.asarray(o.numpy()) for o in outputs])
+            outputs.append(self.predict_batch(x))
+        outputs = [list(outs) for outs in zip(*outputs)]   # [output][batch]
+        if stack_outputs:
+            outputs = [np.vstack(outs) for outs in outputs]
         return outputs
 
     # -- persistence -----------------------------------------------------------

@@ -53,7 +53,11 @@ class TestModelFit:
         ds = io.TensorDataset([
             paddle.to_tensor(np.random.randn(10, 4).astype(np.float32))])
         preds = model.predict(ds, batch_size=4, stack_outputs=True)
-        assert preds.shape == (10, 2)
+        # reference nesting: one entry per output, vstacked when stacking
+        assert len(preds) == 1 and preds[0].shape == (10, 2)
+        raw = model.predict(ds, batch_size=4, verbose=0)
+        assert len(raw) == 1 and len(raw[0]) == 3     # [output][batch]
+        assert raw[0][0].shape == (4, 2)
 
     def test_save_load_roundtrip(self, tmp_path):
         net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
