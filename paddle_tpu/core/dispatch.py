@@ -50,6 +50,26 @@ _amp_cast_hook = None
 _static_record_hook = None
 
 
+def no_static_record():
+    """Context manager suspending static-Program recording — for code
+    that EXECUTES ops while a program records (composite control-flow
+    internals, Executor train replay): the sub-dispatches must not leak
+    into the program as stray top-level ops."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        global _static_record_hook
+        h = _static_record_hook
+        _static_record_hook = None
+        try:
+            yield
+        finally:
+            _static_record_hook = h
+
+    return _cm()
+
+
 def apply_op(
     name: str,
     primal: Callable,

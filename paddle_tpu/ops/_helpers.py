@@ -35,12 +35,28 @@ def op(name, primal, tensor_args, kwargs=None, n_outs=1):
 
 
 def nondiff(name, primal, args, kwargs=None, n_outs=1):
-    """Run an op with no tape recording (integer/bool outputs etc.)."""
+    """Run an op with no tape recording (integer/bool outputs etc.).
+
+    Static-Program recording still sees it: a comparison like
+    ``x[0] > 0`` must become a program op, or its record-time value
+    (computed on the feed PLACEHOLDER) would be baked as a constant into
+    every replay — a cond over a feed-derived pred permanently took the
+    placeholder's branch before this hook call existed."""
+    kwargs = kwargs or {}
     arrays = [unwrap(a) for a in args]
-    out = primal(*arrays, **(kwargs or {}))
+    out = primal(*arrays, **kwargs)
     if n_outs == 1 and not isinstance(out, (tuple, list)):
-        return wrap(out)
-    return tuple(wrap(o) for o in out)
+        outs = (wrap(out),)
+        single = True
+    else:
+        outs = tuple(wrap(o) for o in out)
+        single = False
+    from ..core import dispatch
+
+    h = dispatch._static_record_hook
+    if h is not None:
+        h(name, primal, args, kwargs, outs)
+    return outs[0] if single else outs
 
 
 def paddle_reshape_shape(orig_shape, shape):

@@ -23,8 +23,13 @@ def astype(x, dtype):
 
 def reshape(x, shape, name=None):
     shape = as_int_list(shape)
-    tgt = paddle_reshape_shape(x.shape, shape)
-    return op("reshape", lambda a: jnp.reshape(a, tgt), [x])
+    # resolve 0/-1 entries from the RUNTIME array's shape, not the
+    # build-time tensor: under static recording x.shape carries the
+    # feed placeholder's dummy batch, and resolving here would bake it
+    # into the replayed program (SymbolicDim taint flagged exactly this)
+    return op("reshape",
+              lambda a: jnp.reshape(a, paddle_reshape_shape(
+                  list(a.shape), shape)), [x])
 
 
 def reshape_(x, shape, name=None):
@@ -42,9 +47,14 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     nd = x.ndim
     s = start_axis % nd if start_axis < 0 else start_axis
     e = stop_axis % nd if stop_axis < 0 else stop_axis
-    shape = x.shape
-    new_shape = shape[:s] + [int(np.prod(shape[s : e + 1])) if e >= s else 1] + shape[e + 1 :]
-    return op("flatten", lambda a: jnp.reshape(a, new_shape), [x])
+
+    def _primal(a):
+        sh = list(a.shape)     # runtime shape: never bakes feed dummies
+        new_shape = sh[:s] + \
+            [int(np.prod(sh[s:e + 1])) if e >= s else 1] + sh[e + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return op("flatten", _primal, [x])
 
 
 def transpose(x, perm, name=None):

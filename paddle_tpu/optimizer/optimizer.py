@@ -210,6 +210,42 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..core import dispatch
+
+        if dispatch._static_record_hook is not None:
+            # static-graph idiom: minimize marks the recording program as
+            # a TRAIN program (reference: the ProgramDesc carries the
+            # backward + sgd ops after minimize, so exe.run applies
+            # updates every call).  Never run an eager step here — the
+            # placeholders hold dummy values.
+            from ..nn.layer_base import Parameter
+            from ..static import program as prog_mod
+
+            prog = prog_mod.default_main_program()
+            if parameters is not None:
+                self._parameter_list = list(parameters)
+            if self._parameter_list is None:
+                seen, params = set(), []
+                for op in prog._raw:
+                    for a in op.inputs:
+                        if (isinstance(a, Parameter)
+                                and not a.stop_gradient
+                                and getattr(a, "trainable", True)
+                                and id(a) not in seen):
+                            seen.add(id(a))
+                            params.append(a)
+                if not params:
+                    raise ValueError(
+                        "minimize() found no trainable Parameters in the "
+                        "recording program (was it already run/finalized, "
+                        "or built without static.nn/create_parameter "
+                        "layers?); pass parameters= explicitly")
+                self._parameter_list = params
+            prog._train_spec = (loss, self)
+            prog._train_cache.clear()     # a re-minimize replaces the spec
+            return None, None
+        if parameters is not None and self._parameter_list is None:
+            self._parameter_list = list(parameters)
         loss.backward()
         self.step()
         return None, None

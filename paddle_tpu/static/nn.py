@@ -22,6 +22,15 @@ from ..core import autograd
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
 
+
+def _no_record():
+    """Composite control-flow internals record as ONE op — their branch
+    bodies' sub-dispatches must not leak into the program (they would
+    replay tracer garbage)."""
+    from ..core.dispatch import no_static_record
+
+    return no_static_record()
+
 from .nn_compat import *  # noqa: F401,F403 — fluid-style builders
 from . import nn_compat as _nn_compat
 
@@ -33,14 +42,58 @@ def _is_traced(t) -> bool:
     return isinstance(arr, jax.core.Tracer)
 
 
+def _static_recording() -> bool:
+    """True while a Program is recording (enable_static + program scope).
+    Record-time values are concrete PLACEHOLDERS, so a concrete pred must
+    NOT fold the control flow away — the baked branch would replay for
+    every future feed (a cond over a feed-derived pred recorded only
+    `x - 1` before this check existed)."""
+    from ..core import dispatch
+
+    return getattr(dispatch, "_static_record_hook", None) is not None
+
+
 def _leaves_of(fn) -> list:
-    layer = getattr(fn, "__self__", None)
+    """Tensors a branch/body function can read without taking them as
+    operands: bound-Layer state, plus any Tensor (or Layer) captured in
+    the function's closure — the reference's cond/while_loop let
+    closures just work, so a closured feed placeholder must become a
+    lifted input rather than a baked record-time constant."""
     from ..nn.layer_base import Layer
 
-    if isinstance(layer, Layer):
+    def layer_state(layer):
         return list(layer.parameters()) + \
             [b for _, b in layer.named_buffers()]
-    return []
+
+    leaves = []
+    layer = getattr(fn, "__self__", None)
+    if isinstance(layer, Layer):
+        leaves.extend(layer_state(layer))
+    candidates = []
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            candidates.append(cell.cell_contents)
+        except ValueError:
+            continue
+    code = getattr(fn, "__code__", None)
+    glb = getattr(fn, "__globals__", None)
+    if code is not None and glb is not None:
+        # module-level tensors the function reads (co_names bounds this
+        # to names it actually references)
+        candidates.extend(glb.get(nm) for nm in code.co_names
+                          if nm in glb)
+    for v in candidates:
+        if isinstance(v, Tensor):
+            leaves.append(v)
+        elif isinstance(v, Layer):
+            leaves.extend(layer_state(v))
+    # dedupe by identity, preserving order
+    seen, out = set(), []
+    for t in leaves:
+        if id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+    return out
 
 
 def cond(pred, true_fn: Callable, false_fn: Callable, operands: Sequence = (),
@@ -54,13 +107,18 @@ def cond(pred, true_fn: Callable, false_fn: Callable, operands: Sequence = (),
     the fns be bound Layer methods) so gradients flow — same contract as
     fleet recompute.
     """
-    if not _is_traced(pred):
+    if not _is_traced(pred) and \
+            not (_static_recording() and isinstance(pred, Tensor)):
         taken = true_fn if bool(
             pred.item() if isinstance(pred, Tensor) else pred) else false_fn
         return taken(*operands)
 
     externals = list(params) if params is not None else \
         (_leaves_of(true_fn) + _leaves_of(false_fn))
+    # dedupe by identity (the same tensor may be closured in both fns)
+    _seen = set()
+    externals = [t for t in externals
+                 if not (id(t) in _seen or _seen.add(id(t)))]
     tensor_ops = [o for o in operands if isinstance(o, Tensor)]
     n_ops = len(tensor_ops)
     n_outs = _probe_n_outs(true_fn, operands)
@@ -76,7 +134,7 @@ def cond(pred, true_fn: Callable, false_fn: Callable, operands: Sequence = (),
             try:
                 for t, a in zip(externals, ext_arrays):
                     t._data = a
-                with autograd.no_grad():
+                with autograd.no_grad(), _no_record():
                     out = fn(*full)
             finally:
                 for t, a in saved:
@@ -104,7 +162,7 @@ def _probe_n_outs(fn, operands) -> int:
         it = iter(arrs)
         full = [Tensor._wrap(next(it)) if isinstance(o, Tensor) else o
                 for o in operands]
-        with autograd.no_grad():
+        with autograd.no_grad(), _no_record():
             out = fn(*full)
         outs = out if isinstance(out, (tuple, list)) else (out,)
         return tuple(o._value() if isinstance(o, Tensor) else jnp.asarray(o)
@@ -125,7 +183,9 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
     body/cond must be pure functions of them.
     """
     loop_vars = list(loop_vars)
-    traced = any(_is_traced(v) for v in loop_vars if isinstance(v, Tensor))
+    traced = any(_is_traced(v) for v in loop_vars if isinstance(v, Tensor)) \
+        or (_static_recording()
+            and any(isinstance(v, Tensor) for v in loop_vars))
     if not traced:
         out = loop_vars
         while bool(_as_scalar(cond_fn(*out))):
@@ -139,7 +199,7 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
         full = list(loop_vars)
         for j, i in enumerate(idx):
             full[i] = Tensor._wrap(arrays[j])
-        with autograd.no_grad():
+        with autograd.no_grad(), _no_record():
             out = fn(*full)
         if scalar:
             return jnp.asarray(

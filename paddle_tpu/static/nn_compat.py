@@ -60,7 +60,9 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     for xi in xs:
         shape = xi.shape
         in_dim = int(np.prod(shape[num_flatten_dims:]))
-        flat = xi.reshape(list(shape[:num_flatten_dims]) + [in_dim])
+        # 0 = keep original dim (paddle reshape semantics): never bake a
+        # feed's None-dim dummy into the reshape attr
+        flat = xi.reshape([0] * num_flatten_dims + [in_dim])
         w = _param([in_dim, size], str(xi.dtype))
         outs.append(flat.matmul(w))
     out = outs[0]

@@ -107,6 +107,11 @@ class Program:
         # so hash-based sets cannot hold tensors)
         self._descendants: Dict[int, object] = {}
         self._baked_shape_ops: List[str] = []
+        # set by Optimizer.minimize while this program records: running
+        # the program then TRAINS (reference: the ProgramDesc contains
+        # the backward + sgd ops, so exe.run applies updates)
+        self._train_spec = None            # (loss Tensor, Optimizer)
+        self._train_cache: Dict[tuple, object] = {}
 
     def _is_descendant(self, t) -> bool:
         r = self._descendants.get(id(t))
@@ -276,6 +281,75 @@ class Program:
         leaf_arrays = [t._data for t in self._leaves]
         return jitted(feed_arrays, leaf_arrays)
 
+    # -- training replay -------------------------------------------------
+    def _train_replay(self, feed_arrays: Dict[str, object], fetch_locs):
+        """Run the program AS A TRAIN STEP (set up by Optimizer.minimize):
+        the recorded forward graph is re-dispatched through apply_op under
+        `to_static`, so the autograd tape, the optimizer update, and the
+        parameter/accumulator writes all compile into one XLA program —
+        the same machinery the eager train loop uses.  (The pure replay
+        path cannot train: backward and optimizer math run on raw arrays
+        through vjp closures, invisible to the op recorder — reference
+        programs instead carry explicit grad/sgd ops in the ProgramDesc.)"""
+        self._finalize()
+        loss_t, opt = self._train_spec
+        loss_kind, loss_idx = self._locate(loss_t)
+        feed_names = tuple(sorted(feed_arrays))
+        feed_leaf_idx = {}
+        for fname in feed_names:
+            kind, idx = self._locate(self._feed_vars[fname])
+            if kind != "leaf":
+                raise KeyError(f"feed target {fname!r} is not a leaf")
+            feed_leaf_idx[fname] = idx
+
+        key = (feed_names, tuple(fetch_locs))
+        step = self._train_cache.get(key)
+        if step is None:
+            from ..core import dispatch
+            from ..jit import to_static
+
+            ssa = self._ssa
+            leaves = self._leaves
+
+            def step_fn(*feed_ts):
+                sub = {feed_leaf_idx[nm]: ft
+                       for nm, ft in zip(feed_names, feed_ts)}
+                env: List[object] = [None] * self._n_slots
+
+                def resolve(kind, v):
+                    if kind == "slot":
+                        return env[v]
+                    if kind == "leaf":
+                        return sub.get(v, leaves[v])
+                    return v
+
+                # suspend static recording: we are EXECUTING the program,
+                # and enable_static leaves the record hook pointed at the
+                # current default program
+                with dispatch.no_static_record():
+                    for op in ssa:
+                        args = [resolve(k, v) for k, v in op.in_refs]
+                        outs = dispatch.apply_op(
+                            op.name, op.primal, args, dict(op.kwargs),
+                            n_outs=len(op.out_slots))
+                        outs = outs if isinstance(outs, tuple) else (outs,)
+                        for s, o in zip(op.out_slots, outs):
+                            env[s] = o
+                    loss = resolve(loss_kind, loss_idx)
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                return tuple(resolve(k, i) for k, i in fetch_locs)
+
+            step = to_static(step_fn)
+            self._train_cache[key] = step
+
+        feed_ts = [Tensor._wrap(feed_arrays[nm]) for nm in feed_names]
+        outs = step(*feed_ts)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        return tuple(o._value() if isinstance(o, Tensor) else o
+                     for o in outs)
+
     def __repr__(self):
         n = len(self._raw) if self._ssa is None else len(self._ssa)
         return f"Program(num_ops={n})"
@@ -374,13 +448,19 @@ def data(name, shape, dtype=None, lod_level=0):
                 if s_ is None or int(s_) < 0]
     # None dims record at a DISTINCTIVE dummy size (not 1: size-1 dims are
     # everywhere — keepdim axes, singleton channels — and would false-flag
-    # the shape-bake guard).  Each program cycles through odd primes so a
-    # dim VALUE identifies which feed it derived from.
+    # the shape-bake guard).  The FIRST None axis of every feed shares ONE
+    # dummy: it is the batch axis in practice, and `pred - y` style ops
+    # combining two feeds' batch dims must broadcast at record time (a
+    # per-feed batch dummy made x:[None,4] minus y:[None,1] a record-time
+    # shape error).  Additional None axes cycle through odd primes so
+    # their dim VALUE still identifies the deriving feed.
     concrete = []
     sym_val = {}
+    first_none = sym_axes[0] if sym_axes else None
     for i, s_ in enumerate(shape):
         if i in sym_axes:
-            v = _next_sym_size(_current_main)
+            v = _SYM_SIZE_POOL[0] if i == first_none \
+                else _next_sym_size(_current_main)
             sym_val[i] = v
             concrete.append(v)
         else:
@@ -401,10 +481,12 @@ _SYM_SIZE_POOL = (61, 67, 71, 73, 79, 83, 89, 97, 101, 103, 107, 109, 113)
 
 
 def _next_sym_size(prog) -> int:
-    for v in _SYM_SIZE_POOL:
+    # pool[0] is reserved as the shared batch dummy (data() above)
+    for v in _SYM_SIZE_POOL[1:]:
         if v not in prog._sym_dummy:
             return v
-    return _SYM_SIZE_POOL[len(prog._sym_dummy) % len(_SYM_SIZE_POOL)]
+    return _SYM_SIZE_POOL[
+        1 + len(prog._sym_dummy) % (len(_SYM_SIZE_POOL) - 1)]
 
 
 class Scope:
@@ -500,7 +582,10 @@ class Executor:
             feed_arrays[k] = arr
         prog._finalize()
         fetch_locs = tuple(prog._locate(t) for t in fetch_list)
-        outs = prog._replay(feed_arrays, fetch_locs)
+        if prog._train_spec is not None:
+            outs = prog._train_replay(feed_arrays, fetch_locs)
+        else:
+            outs = prog._replay(feed_arrays, fetch_locs)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor._wrap(o) for o in outs]

@@ -358,3 +358,132 @@ class TestShapeAttrBakeDetection:
         out, = exe.run(main, feed={'x': np.ones((5, 6), np.float32)},
                        fetch_list=[y])
         np.testing.assert_allclose(np.asarray(out), 5 * 6 * 1 * 2 + 12)
+
+
+class TestStaticTraining:
+    """The classic reference static idiom: build program, minimize, then
+    exe.run(feed=...) TRAINS (the ProgramDesc carries backward+sgd ops —
+    reference test model: unittests test_fit_a_line)."""
+
+    def test_sgd_minimize_trains_via_executor(self):
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 4], "float32")
+                y = static.data("y", [None, 1], "float32")
+                pred = static.nn.fc(x, 1)
+                loss = paddle.mean((pred - y) ** 2)
+                opt = paddle.optimizer.SGD(0.1)
+                opt.minimize(loss)
+            # minimize with no parameter list collects the program's
+            # Parameters (2: fc weight + bias)
+            assert len(opt._parameter_list) == 2
+            exe = static.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(0)
+            xs = rs.randn(32, 4).astype(np.float32)
+            w = rs.randn(4, 1).astype(np.float32)
+            ys = xs @ w
+            first = last = None
+            for _ in range(100):
+                lv, = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                first = first if first is not None else float(lv)
+                last = float(lv)
+            assert last < 1e-3 < first
+        finally:
+            paddle.disable_static()
+
+    def test_minimize_with_explicit_parameters_trains(self):
+        # explicit parameter lists must ALSO install the train path (and
+        # never run an eager garbage step on the record-time dummies)
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 2], "float32")
+                y = static.data("y", [None, 1], "float32")
+                pred = static.nn.fc(x, 1)
+                loss = paddle.mean((pred - y) ** 2)
+                opt = paddle.optimizer.SGD(0.1)
+                opt.minimize(loss, parameters=opt._parameter_list
+                             or None)  # None → collect, then re-minimize
+                opt2 = paddle.optimizer.SGD(
+                    0.1, parameters=opt._parameter_list)
+                opt2.minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            xs = np.random.RandomState(0).randn(16, 2).astype(np.float32)
+            ys = (xs @ np.array([[1.0], [-2.0]], np.float32))
+            first = last = None
+            for _ in range(80):
+                lv, = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                first = first if first is not None else float(lv)
+                last = float(lv)
+            assert last < 1e-2 < first
+        finally:
+            paddle.disable_static()
+
+    def test_two_none_batch_feeds_combine(self):
+        # x:[None,4] minus y:[None,1] must record (shared batch dummy);
+        # a per-feed dummy made this a record-time broadcast error
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [None, 4], "float32")
+                y = static.data("y", [None, 1], "float32")
+                d = paddle.mean((x - y) ** 2)
+            exe = static.Executor()
+            r, = exe.run(main, feed={"x": np.ones((5, 4), np.float32),
+                                     "y": np.zeros((5, 1), np.float32)},
+                         fetch_list=[d])
+            np.testing.assert_allclose(r, 1.0)
+        finally:
+            paddle.disable_static()
+
+
+class TestStaticControlFlowOverFeeds:
+    def test_cond_follows_the_feed(self):
+        # the pred is feed-derived: the recorded program must keep BOTH
+        # branches (regression: the placeholder's branch was baked, and
+        # the un-recorded comparison baked pred=False permanently)
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [1], "float32")
+                out = static.nn.cond(x[0] > 0, lambda: x * 2, lambda: x - 1)
+            exe = static.Executor()
+            r, = exe.run(main, feed={"x": np.array([3.0], np.float32)},
+                         fetch_list=[out])
+            np.testing.assert_allclose(r, [6.0])
+            r, = exe.run(main, feed={"x": np.array([-3.0], np.float32)},
+                         fetch_list=[out])
+            np.testing.assert_allclose(r, [-4.0])
+        finally:
+            paddle.disable_static()
+
+    def test_while_loop_over_feed(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [1], "float32")
+                i = paddle.zeros([1], "float32")
+
+                def cond(i, s):
+                    return i[0] < 5
+
+                def body(i, s):
+                    return i + 1, s * 2
+
+                _, out = static.nn.while_loop(cond, body, [i, x])
+            exe = static.Executor()
+            r, = exe.run(main, feed={"x": np.array([1.0], np.float32)},
+                         fetch_list=[out])
+            np.testing.assert_allclose(r, [32.0])
+        finally:
+            paddle.disable_static()
