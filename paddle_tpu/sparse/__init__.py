@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -180,3 +181,39 @@ add = _ewise("add", lambda a, b: a + b)
 subtract = _ewise("subtract", lambda a, b: a - b)
 multiply = _ewise("multiply", lambda a, b: a * b)
 divide = _ewise("divide", lambda a, b: a / b)
+
+
+# -- dense Tensor -> sparse conversion methods (reference patches these
+# onto dense tensors: varbase_patch_methods.py:956 to_sparse_coo) -------
+
+def _dense_to_sparse_coo(self, sparse_dim=2):
+    """Dense -> COO over the leading `sparse_dim` axes (trailing axes
+    stay dense in the values).  Eager-only: the nnz is data-dependent,
+    which no fixed-shape compiled program can carry.  The values gather
+    goes through the dispatch tape, so grads flow back to the dense
+    tensor (reference: the dense_to_coo kernel has a grad)."""
+    arr = self._value()
+    if isinstance(arr, jax.core.Tracer):
+        raise RuntimeError(
+            "to_sparse_coo is eager-only: the number of nonzeros is "
+            "data-dependent and cannot live in a compiled program")
+    host = np.asarray(arr)
+    nd = host.ndim
+    sd = int(sparse_dim)
+    if not 1 <= sd <= nd:
+        raise ValueError(f"sparse_dim must be in [1, {nd}], got {sd}")
+    mask = host != 0
+    if sd < nd:
+        mask = mask.any(axis=tuple(range(sd, nd)))
+    idx = np.nonzero(mask)
+    indices = np.stack([i.astype(np.int64) for i in idx])
+    values = op("dense_to_coo_values",
+                lambda a: a[tuple(jnp.asarray(i) for i in idx)], [self])
+    out = sparse_coo_tensor(indices, values, shape=list(host.shape),
+                            stop_gradient=self.stop_gradient)
+    return out
+
+
+from ..core.tensor import register_tensor_method as _reg  # noqa: E402
+
+_reg("to_sparse_coo", _dense_to_sparse_coo)

@@ -307,3 +307,28 @@ class TestSparseReviewRegressions:
         idx, vals = _rand_coo((4, 4), 3, seed=21)
         t = sparse.sparse_coo_tensor(idx, vals, (4, 4)).coalesce()
         assert t.coalesce() is t
+
+
+def test_dense_to_sparse_coo_method():
+    # reference patches to_sparse_coo onto dense tensors
+    # (varbase_patch_methods.py:956)
+    d = paddle.to_tensor(np.array([[0., 1.], [3., 0.]], np.float32))
+    s = d.to_sparse_coo(2)
+    assert int(s.nnz()) == 2
+    np.testing.assert_allclose(s.to_dense().numpy(), d.numpy())
+    # trailing dense dims
+    d3 = paddle.to_tensor(np.array([[[1., 2.], [0., 0.]],
+                                    [[0., 0.], [3., 4.]]], np.float32))
+    s3 = d3.to_sparse_coo(2)
+    assert int(s3.nnz()) == 2
+    np.testing.assert_allclose(s3.to_dense().numpy(), d3.numpy())
+
+
+def test_dense_to_sparse_coo_grads_flow():
+    x = paddle.to_tensor(np.array([[0., 1.], [3., 0.]], np.float32),
+                         stop_gradient=False)
+    s = x.to_sparse_coo(2)
+    assert s.stop_gradient is False
+    (s.values() * paddle.to_tensor(np.array([2., 5.], np.float32))) \
+        .sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0., 2.], [5., 0.]])
