@@ -227,6 +227,16 @@ def save(layer, path, input_spec=None, **configs):
         net.eval()
 
     raw_fn = fwd._fn if isinstance(fwd, StaticFunction) else fwd
+    # AST-convert python control flow exactly like @to_static does —
+    # exporting the raw forward would TracerBool on the first
+    # tensor-dependent `if` that conversion handles.  Honors the same
+    # kill-switch as StaticFunction.
+    import os as _os
+
+    if not _os.environ.get("PADDLE_TPU_NO_AST_CONVERT"):
+        from .dy2static import convert_function
+
+        raw_fn = convert_function(raw_fn)
 
     def pure(state_arrays, in_arrays):
         originals = [state[n]._data for n in names]

@@ -644,9 +644,10 @@ def _aval_of(v):
 
 def _broadcast_shapes(a, b, name):
     try:
-        import numpy as _np
-        return tuple(_np.broadcast_shapes(a, b))
-    except ValueError:
+        # jnp handles SYMBOLIC dims (shape-polymorphic jit.save export);
+        # np.broadcast_shapes rejects _DimExpr entries
+        return tuple(jnp.broadcast_shapes(tuple(a), tuple(b)))
+    except Exception:
         raise Dy2StaticError(
             f"variable '{name}' has incompatible shapes across the two "
             f"branches of a converted `if` ({a} vs {b})")

@@ -209,8 +209,10 @@ def _dense_to_sparse_coo(self, sparse_dim=2):
     indices = np.stack([i.astype(np.int64) for i in idx])
     values = op("dense_to_coo_values",
                 lambda a: a[tuple(jnp.asarray(i) for i in idx)], [self])
-    out = sparse_coo_tensor(indices, values, shape=list(host.shape),
-                            stop_gradient=self.stop_gradient)
+    # np.nonzero yields sorted, duplicate-free indices: already canonical
+    out = SparseCooTensor(indices, values, list(host.shape),
+                          coalesced=True)
+    out.stop_gradient = self.stop_gradient
     return out
 
 

@@ -244,3 +244,40 @@ class TestDonateOptOut:
         step(x)
         # donation would have deleted this buffer; donate=False keeps it
         np.asarray(alias)
+
+
+def test_save_load_with_converted_control_flow(tmp_path):
+    """jit.save runs the same AST conversion as @to_static, so a forward
+    with tensor-dependent if/while exports (lax.cond/while in StableHLO)
+    and still follows the data after reload — under symbolic batch."""
+    import paddle_tpu.nn as nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.sum() > 0:
+                return h * 2
+            i = 0
+            while i < 2:
+                h = h + 1
+                i += 1
+            return h
+
+    paddle.seed(0)
+    net = Net()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    xneg = paddle.to_tensor(-np.ones((2, 4), np.float32) * 10)
+    want_pos, want_neg = net(x).numpy(), net(xneg).numpy()
+
+    path = str(tmp_path / "net")
+    paddle.jit.save(net, path, input_spec=[
+        paddle.static.InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), want_pos, rtol=1e-5)
+    np.testing.assert_allclose(loaded(xneg).numpy(), want_neg, rtol=1e-5)
+    assert loaded(paddle.to_tensor(
+        np.ones((7, 4), np.float32))).shape[0] == 7
