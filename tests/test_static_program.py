@@ -426,6 +426,43 @@ class TestStaticTraining:
         finally:
             paddle.disable_static()
 
+    def test_adam_scheduler_lr_reaches_compiled_step(self):
+        # accumulators (moments) + an lr scheduler stepping BETWEEN runs
+        # must reach the compiled train step WITHOUT a recompile (lr is
+        # an external tensor).  gamma ~0 freezes training after the
+        # decay fires - a baked-in lr would keep the loss moving.
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 4], "float32")
+                y = static.data("y", [None, 1], "float32")
+                pred = static.nn.fc(x, 1)
+                loss = paddle.mean((pred - y) ** 2)
+                sch = paddle.optimizer.lr.StepDecay(0.05, step_size=5,
+                                                    gamma=1e-9)
+                paddle.optimizer.Adam(sch).minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(0)
+            xs = rs.randn(32, 4).astype(np.float32)
+            ys = xs @ rs.randn(4, 1).astype(np.float32)
+            losses = []
+            for _ in range(30):
+                lv, = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                sch.step()
+                losses.append(float(lv))
+            assert losses[4] < losses[0]            # lr live: learning
+            # decay fired at step 5 with gamma ~0: loss frozen after -
+            # a baked record-time lr would keep decreasing it
+            assert abs(losses[29] - losses[6]) < 1e-7 * max(
+                1.0, abs(losses[6]))
+            # and the whole run used ONE compiled step (no recompiles)
+            assert len(main._train_cache) == 1
+        finally:
+            paddle.disable_static()
+
     def test_two_none_batch_feeds_combine(self):
         # x:[None,4] minus y:[None,1] must record (shared batch dummy);
         # a per-feed dummy made this a record-time broadcast error
@@ -487,3 +524,4 @@ class TestStaticControlFlowOverFeeds:
             np.testing.assert_allclose(r, [32.0])
         finally:
             paddle.disable_static()
+
