@@ -47,8 +47,42 @@ def _probe_backend(timeout_s: float) -> tuple:
     return False, f"probe rc={r.returncode}: " + " | ".join(tail)
 
 
-def preflight(max_attempts=4, timeouts=(90, 120, 120, 180),
-              backoffs=(15, 30, 60)):
+def preflight(max_attempts=None, timeouts=None, backoffs=None):
+    """Probe the TPU backend before committing to the bench.
+
+    ``PADDLE_TPU_PREFLIGHT_TIMEOUTS=30,60`` overrides the per-attempt
+    probe timeouts AND the attempt count (one attempt per entry);
+    ``PADDLE_TPU_PREFLIGHT_BACKOFFS`` likewise overrides the sleeps
+    between attempts.  CPU CI (r05: four back-to-back probe timeouts, 8+
+    minutes burned reaching a backend that was never going to exist)
+    should instead set JAX_PLATFORMS=cpu, which skips the probe in
+    __main__.
+    """
+    def _env_floats(var, default):
+        raw = os.environ.get(var)
+        if not raw:
+            return default, False
+        try:
+            vals = tuple(float(x) for x in raw.split(",") if x.strip())
+            if not vals or any(v <= 0 for v in vals):
+                raise ValueError("need positive seconds")
+            return vals, True
+        except ValueError:
+            # keep the one-JSON-line failure contract even for a bad
+            # config value — never die with a raw traceback
+            fail_structured(f"invalid {var}={raw!r}: expected "
+                            "comma-separated positive seconds, "
+                            "e.g. '30,60'")
+
+    env_t = None
+    if timeouts is None:
+        timeouts, env_t = _env_floats("PADDLE_TPU_PREFLIGHT_TIMEOUTS",
+                                      (90, 120, 120, 180))
+    if max_attempts is None:
+        max_attempts = len(timeouts) if env_t else 4
+    if backoffs is None:
+        backoffs, _ = _env_floats("PADDLE_TPU_PREFLIGHT_BACKOFFS",
+                                  (15, 30, 60))
     last = "no attempts made"
     for i in range(max_attempts):
         ok, detail = _probe_backend(timeouts[min(i, len(timeouts) - 1)])
@@ -228,6 +262,14 @@ if __name__ == "__main__":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    elif os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # CPU run requested explicitly: there is no tunnel to probe —
+        # pin the platform past the axon sitecustomize and skip preflight
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("bench: JAX_PLATFORMS=cpu — skipping TPU preflight",
+              file=sys.stderr)
     else:
         preflight()
     try:

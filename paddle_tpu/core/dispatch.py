@@ -49,6 +49,15 @@ _amp_cast_hook = None
 # fn(op_name, primal, tensor_args, kwargs, out_tensors) -> None.
 _static_record_hook = None
 
+# Name of the most recently dispatched op — read by the fault-tolerance
+# watchdog when a step stalls, so the hang report names the op that was
+# in flight (a blocked collective shows up here as its dispatching op).
+_last_op_name: str = None
+
+
+def last_dispatched_op():
+    return _last_op_name
+
 
 def no_static_record():
     """Context manager suspending static-Program recording — for code
@@ -84,6 +93,8 @@ def apply_op(
     - returns Tensor (or tuple of Tensors if n_outs > 1)
     """
     kwargs = kwargs or {}
+    global _last_op_name
+    _last_op_name = name
     if _amp_cast_hook is not None:
         tensor_args = _amp_cast_hook(name, tensor_args)
 

@@ -22,7 +22,12 @@ from . import sharding  # noqa: E402
 from . import auto_parallel  # noqa: E402
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op, Engine
 from . import checkpoint  # noqa: E402
-from .checkpoint import save_state_dict, load_state_dict
+from .checkpoint import (
+    save_state_dict, load_state_dict, verify_checkpoint, save_generation,
+    load_generation, latest_valid, list_generations, gc_generations,
+)
+from . import fault_tolerance  # noqa: E402
+from .fault_tolerance import ResilientLoop
 from .sharding_spec import (
     mark_sharding, shard_parameter, set_param_spec, get_param_spec, batch_spec,
 )

@@ -24,8 +24,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from ..core.jax_compat import shard_map
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op
 from . import mesh as mesh_mod

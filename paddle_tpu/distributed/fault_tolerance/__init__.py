@@ -1,0 +1,16 @@
+"""Fault-tolerant training runtime (docs/RESILIENCE.md).
+
+Step-granular auto-resume (`ResilientLoop`), hang detection
+(`StepWatchdog`), and deterministic chaos injection (`FaultPlan`,
+`corrupt_shard`) over the hardened generation checkpoints of
+``distributed.checkpoint`` (CRC32 + verify + keep-last-K retention).
+"""
+from ..fleet.elastic.manager import ELASTIC_EXIT_CODE
+from .injection import FaultPlan, corrupt_shard
+from .resilient_loop import ResilientLoop, pack_state
+from .watchdog import StepWatchdog, dump_all_stacks
+
+__all__ = [
+    "ResilientLoop", "StepWatchdog", "FaultPlan", "corrupt_shard",
+    "dump_all_stacks", "ELASTIC_EXIT_CODE", "pack_state",
+]

@@ -1,0 +1,103 @@
+"""Deterministic fault injection for chaos testing the resilience layer.
+
+Faults are declared via environment variables so the injected process
+needs NO test-specific code — the same training script that runs in
+production runs under chaos, and the chaos suite
+(tests/test_fault_tolerance.py) just sets env on the subprocess:
+
+- ``PADDLE_TPU_FT_DIE_AT_STEP=N``    deliver a signal to self at the
+  start of step N (before the user step fn runs).  The default signal is
+  SIGTERM, which exercises the ResilientLoop preemption path: the loop
+  finishes step N, commits a final generation, and exits with
+  ELASTIC_EXIT_CODE.
+- ``PADDLE_TPU_FT_DIE_SIGNAL=KILL``  signal name (TERM/INT/KILL) or
+  number.  KILL is the un-catchable crash: no final checkpoint, resume
+  must come from the last cadence save.
+- ``PADDLE_TPU_FT_STALL_AT_STEP=N``  sleep inside step N, simulating a
+  hung collective; the step watchdog should fire.
+- ``PADDLE_TPU_FT_STALL_SECONDS=S``  stall duration (default 3600 — an
+  "forever" hang at test scale; the watchdog kills the process first).
+
+Every fault fires at most once per process so a resumed run sails past
+the step that killed its predecessor (the predecessor's env is not
+inherited unless the harness re-sets it — but guard anyway: the chaos
+tests re-launch with the fault env cleared).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+__all__ = ["FaultPlan", "corrupt_shard"]
+
+ENV_DIE_AT_STEP = "PADDLE_TPU_FT_DIE_AT_STEP"
+ENV_DIE_SIGNAL = "PADDLE_TPU_FT_DIE_SIGNAL"
+ENV_STALL_AT_STEP = "PADDLE_TPU_FT_STALL_AT_STEP"
+ENV_STALL_SECONDS = "PADDLE_TPU_FT_STALL_SECONDS"
+
+
+def _parse_signal(spec: str) -> int:
+    if spec.isdigit():
+        return int(spec)
+    name = spec.upper()
+    if not name.startswith("SIG"):
+        name = "SIG" + name
+    return int(getattr(signal, name))
+
+
+class FaultPlan:
+    """The faults this process has been asked to inject, step-keyed."""
+
+    def __init__(self, die_at_step: Optional[int] = None,
+                 die_signal: int = signal.SIGTERM,
+                 stall_at_step: Optional[int] = None,
+                 stall_seconds: float = 3600.0):
+        self.die_at_step = die_at_step
+        self.die_signal = die_signal
+        self.stall_at_step = stall_at_step
+        self.stall_seconds = stall_seconds
+        self._fired_die = False
+        self._fired_stall = False
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "FaultPlan":
+        die = env.get(ENV_DIE_AT_STEP)
+        stall = env.get(ENV_STALL_AT_STEP)
+        return cls(
+            die_at_step=int(die) if die is not None else None,
+            die_signal=_parse_signal(env.get(ENV_DIE_SIGNAL, "TERM")),
+            stall_at_step=int(stall) if stall is not None else None,
+            stall_seconds=float(env.get(ENV_STALL_SECONDS, "3600")))
+
+    @property
+    def armed(self) -> bool:
+        return self.die_at_step is not None or self.stall_at_step is not None
+
+    def fire(self, step: int):
+        """Called by ResilientLoop at the start of every step."""
+        if self.stall_at_step == step and not self._fired_stall:
+            self._fired_stall = True
+            time.sleep(self.stall_seconds)
+        if self.die_at_step == step and not self._fired_die:
+            self._fired_die = True
+            os.kill(os.getpid(), self.die_signal)
+
+
+def corrupt_shard(ckpt_path: str, nth: int = 0, flip_at: float = 0.5) -> str:
+    """Flip one byte of the ``nth`` shard file (sorted order) of a
+    committed checkpoint directory — the bit-rot half of the chaos suite.
+    Returns the corrupted filename."""
+    shards = sorted(f for f in os.listdir(ckpt_path) if f.endswith(".npy"))
+    if not shards:
+        raise FileNotFoundError(f"no shard files under {ckpt_path}")
+    target = os.path.join(ckpt_path, shards[nth % len(shards)])
+    size = os.path.getsize(target)
+    pos = max(0, min(size - 1, int(size * flip_at)))
+    with open(target, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return shards[nth % len(shards)]

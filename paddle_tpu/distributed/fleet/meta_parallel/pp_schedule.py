@@ -39,9 +39,9 @@ from typing import List, Sequence
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ....core.jax_compat import shard_map
 from ....core import autograd
 from ....core import rng as rng_mod
 from ....core.dispatch import apply_op
@@ -63,12 +63,22 @@ def structure_signature(layer: Layer):
               for name, t in sorted(layer.named_buffers()))
 
 
+def _require_partial_manual():
+    from ....core.jax_compat import SUPPORTS_PARTIAL_MANUAL
+
+    if not SUPPORTS_PARTIAL_MANUAL:
+        raise RuntimeError(
+            "the compiled pipeline schedule requires partial-manual "
+            "shard_map (jax.shard_map with axis_names), which this JAX "
+            "version lacks — upgrade JAX or run with pp=1")
+
+
 def _pipe_varying(x):
-    """Mark an array pipe-varying for the shard_map carry (pvary is
-    deprecated in favor of pcast)."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, ("pipe",), to="varying")
-    return jax.lax.pvary(x, ("pipe",))
+    """Mark an array pipe-varying for the shard_map carry (jax_compat
+    resolves the pcast/pvary/identity version spread)."""
+    from ....core.jax_compat import pvary
+
+    return pvary(x, ("pipe",))
 
 
 def _psum_pipe_f32(x):
@@ -141,6 +151,7 @@ def _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh, key_arr,
     computation is `copy`, and XLA CPU's bf16 AllReducePromotion pass
     CHECK-crashes cloning it ("Invalid binary instruction opcode copy"),
     killing every bf16 test on the virtual CPU mesh.)"""
+    _require_partial_manual()
 
     def inner(key_l, xs_full, *extras):
         stage = jax.lax.axis_index("pipe")
@@ -209,6 +220,7 @@ def _scan_pipeline_interleaved(chunk_fn, xs, n_stages, n_micro, n_virtual,
     schedule: the tick body is rematerialized, so the backward holds one
     per-tick chunk input.
     """
+    _require_partial_manual()
     vP = n_virtual * n_stages
     n_ticks = n_virtual * n_micro + n_stages - 1
 

@@ -48,6 +48,12 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--max_restarts", type=int, default=None,
                    help="restarts after worker failure before giving up "
                         "(default: 0 for plain launch, 3 for elastic)")
+    p.add_argument("--max_relaunches", type=int,
+                   default=int(os.environ.get(
+                       "PADDLE_TPU_MAX_RELAUNCHES", "100")),
+                   help="cap on worker-REQUESTED relaunches (exit code "
+                        "101: preemption commit / hang watchdog) — these "
+                        "do not consume the --max_restarts fault budget")
     p.add_argument("--start_port", type=int,
                    default=int(os.environ.get("PADDLE_START_PORT", "6170")))
     p.add_argument("--elastic_coordinator", type=str,
@@ -281,6 +287,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
     if args.max_restarts is None:
         args.max_restarts = 0      # plain launch: no implicit restarts
     restarts = 0
+    relaunches = 0
     while True:
         workers = _build_workers(args, master)
         for w in workers:
@@ -323,6 +330,23 @@ def launch(argv: Optional[List[str]] = None) -> int:
               f"tearing down peers", file=sys.stderr)
         for w in workers:
             w.terminate()
+        from ..fleet.elastic.manager import ELASTIC_EXIT_CODE
+
+        if rc == ELASTIC_EXIT_CODE:
+            # the worker ASKED to be relaunched (ResilientLoop preemption
+            # commit, or the step watchdog detecting a hang) — honor it
+            # without consuming the fault budget; its checkpoint
+            # generations make the restart cheap (reference: elastic
+            # manager treats ELASTIC_EXIT_CODE as RESTART, not ERROR)
+            if relaunches >= args.max_relaunches:
+                print(f"[launch] giving up after {relaunches} requested "
+                      f"relaunches", file=sys.stderr)
+                return rc
+            relaunches += 1
+            master = args.master or f"127.0.0.1:{_free_port()}"
+            print(f"[launch] relaunch {relaunches}/{args.max_relaunches} "
+                  f"requested by worker (ranks preserved)", file=sys.stderr)
+            continue
         if restarts >= args.max_restarts:
             print(f"[launch] giving up after {restarts} restarts",
                   file=sys.stderr)

@@ -117,10 +117,50 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Epoch-granular ``model.save`` plus (with ``save_steps``)
+    step-granular checkpoint *generations* under ``<save_dir>/steps`` —
+    CRC-verified, keep-last-K, auto-resumable via
+    ``Model.fit(..., resume=True)`` (docs/RESILIENCE.md)."""
+
+    def __init__(self, save_freq=1, save_dir=None, save_steps=None,
+                 keep_last=3):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.save_steps = save_steps
+        self.keep_last = keep_last
+        self._gstep = 0               # global step across epochs
+
+    @staticmethod
+    def steps_root(save_dir):
+        return os.path.join(save_dir, "steps")
+
+    def on_train_begin(self, logs=None):
+        # fit(resume=True) restored state before training started; pick
+        # the generation numbering up where the previous run left off.
+        # A FRESH fit into a dir that already holds generations must also
+        # continue numbering past them: restarting at 0 would hand every
+        # retention keep-slot to the stale higher-numbered generations
+        # and delete each new checkpoint the moment it commits.
+        start = int(getattr(self.model, "_resumed_step", 0) or 0)
+        if self.save_dir and self.save_steps:
+            from ..distributed import checkpoint as ckpt
+
+            gens = ckpt.list_generations(self.steps_root(self.save_dir))
+            if gens:
+                start = max(start, gens[-1])
+        self._gstep = start
+
+    def on_train_batch_end(self, step, logs=None):
+        if not (self.save_dir and self.save_steps):
+            return
+        self._gstep += 1
+        if self._gstep % self.save_steps == 0:
+            from ..distributed import checkpoint as ckpt
+
+            ckpt.save_generation(self.model._ft_state_dict(self._gstep),
+                                 self.steps_root(self.save_dir),
+                                 self._gstep, keep_last=self.keep_last)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and (epoch + 1) % self.save_freq == 0:

@@ -154,13 +154,20 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, save_steps=None,
+            keep_last=3, resume=False):
         train_loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
 
+        self._resumed_step = 0
+        if save_dir and resume:
+            self._resumed_step = self.resume_from(
+                cbks_mod.ModelCheckpoint.steps_root(save_dir))
         cbs = [cbks_mod.ProgBarLogger(log_freq, verbose=verbose)]
         if save_dir:
-            cbs.append(cbks_mod.ModelCheckpoint(save_freq, save_dir))
+            cbs.append(cbks_mod.ModelCheckpoint(save_freq, save_dir,
+                                                save_steps=save_steps,
+                                                keep_last=keep_last))
         if callbacks:
             cbs.extend(callbacks)
         cbk_list = cbks_mod.CallbackList(cbs)
@@ -244,6 +251,43 @@ class Model:
         if stack_outputs:
             outputs = [np.vstack(outs) for outs in outputs]
         return outputs
+
+    # -- fault tolerance -------------------------------------------------------
+
+    def _ft_user_state(self):
+        state = {"model": self.network.state_dict()}
+        if self._optimizer is not None:
+            state["opt"] = self._optimizer.state_dict()
+        return state
+
+    def _ft_restore(self, user_state):
+        self.network.set_state_dict(user_state["model"])
+        if self._optimizer is not None and "opt" in user_state:
+            self._optimizer.set_state_dict(user_state["opt"])
+
+    def _ft_state_dict(self, step):
+        """Generation payload via the shared ResilientLoop schema, so
+        fit-produced step checkpoints and ResilientLoop ones share one
+        resume contract (docs/RESILIENCE.md)."""
+        from ..distributed.fault_tolerance import pack_state
+
+        return pack_state(self._ft_user_state(), step)
+
+    def resume_from(self, ckpt_root):
+        """Restore params/optimizer/RNG from the newest VALID step
+        generation under ``ckpt_root`` (corrupt/torn generations are
+        skipped).  Returns the restored global step (0 = fresh start).
+
+        Note: fit-level resume restores state and continues generation
+        numbering; it does not fast-forward the data iterator to the
+        exact batch — for bitwise step-exact resume drive training with
+        ``distributed.fault_tolerance.ResilientLoop``.
+        """
+        from ..distributed.fault_tolerance import ResilientLoop
+
+        loop = ResilientLoop(ckpt_root, state_fn=self._ft_user_state,
+                             restore_fn=self._ft_restore, verbose=False)
+        return loop.resume()
 
     # -- persistence -----------------------------------------------------------
 

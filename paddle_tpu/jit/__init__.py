@@ -13,6 +13,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 import jax
+from ..core.jax_compat import jax_export
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, to_tensor
@@ -256,7 +257,7 @@ def save(layer, path, input_spec=None, **configs):
     # None/-1 InputSpec dims export as SYMBOLIC dimensions (shared scope):
     # the served model accepts any size there (reference
     # save_inference_model's -1 dims; jax shape polymorphism)
-    scope = jax.export.SymbolicScope()
+    scope = jax_export.SymbolicScope()
     sym_iter = iter(f"_d{i}" for i in range(64))
     in_avals = []
     for spec_i, arr in zip(list(input_spec) + [None] * len(in_arrays),
@@ -267,11 +268,11 @@ def save(layer, path, input_spec=None, **configs):
             dims = ",".join(
                 next(sym_iter) if (d is None or int(d) < 0) else str(int(d))
                 for d in declared)
-            shp = jax.export.symbolic_shape(dims, scope=scope)
+            shp = jax_export.symbolic_shape(dims, scope=scope)
             in_avals.append(jax.ShapeDtypeStruct(shp, arr.dtype))
         else:
             in_avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
-    exported = jax.export.export(jax.jit(pure))(
+    exported = jax_export.export(jax.jit(pure))(
         jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                      state_arrays),
         in_avals,
@@ -323,7 +324,7 @@ def load(path, **configs):
 
     with open(path + ".pdmodel", "rb") as f:
         blob = f.read()
-    exported = jax.export.deserialize(blob)
+    exported = jax_export.deserialize(blob)
     state = _fload(path + ".pdiparams")
     return TranslatedLayer(exported, state)
 

@@ -25,9 +25,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..core.jax_compat import shard_map
 from ..core.dispatch import apply_op
 from ..distributed import mesh as mesh_mod
 
@@ -37,9 +37,9 @@ SEP_AXIS = "sep"
 
 
 def _varying(x, axis):
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))
+    from ..core.jax_compat import pvary
+
+    return pvary(x, (axis,))
 
 
 def _ring_inner(q_l, k_l, v_l, p: int, s_local: int, scale: float,
@@ -98,6 +98,13 @@ def ring_flash_attention(query, key, value, is_causal: bool = True,
 
         return flash_attention(query, key, value, is_causal=is_causal,
                                dropout_p=0.0, training=False)
+    from ..core.jax_compat import SUPPORTS_PARTIAL_MANUAL
+
+    if not SUPPORTS_PARTIAL_MANUAL:
+        raise RuntimeError(
+            "ring attention over the sep axis requires partial-manual "
+            "shard_map (jax.shard_map with axis_names), which this JAX "
+            "version lacks — upgrade JAX or set sep=1 in the mesh")
     s_local = S // p
     D = query.shape[-1]
     scale = 1.0 / (D ** 0.5)

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 import jax
+from ..core.jax_compat import jax_export
 import jax.numpy as jnp
 
 from ..core import dispatch as dispatch_mod
@@ -810,13 +811,13 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             else:
                 parts.append(str(int(s)))
         if symbolic:
-            dims = jax.export.symbolic_shape(", ".join(parts))
+            dims = jax_export.symbolic_shape(", ".join(parts))
             shapes.append(jax.ShapeDtypeStruct(tuple(dims),
                                                t._data.dtype))
         else:
             shapes.append(jax.ShapeDtypeStruct(t._data.shape,
                                                t._data.dtype))
-    exported = jax.export.export(jax.jit(infer))(*shapes)
+    exported = jax_export.export(jax.jit(infer))(*shapes)
     with open(path_prefix + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
     with open(path_prefix + ".pdiparams", "wb") as f:
@@ -830,7 +831,7 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     import pickle
 
     with open(path_prefix + ".pdmodel", "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        exported = jax_export.deserialize(f.read())
     with open(path_prefix + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
     prog = _LoadedProgram(exported, meta["feed_names"], meta["n_fetch"])
@@ -860,7 +861,7 @@ def deserialize_program(data):
     import pickle
 
     blob = pickle.loads(data)
-    exported = jax.export.deserialize(blob["hlo"])
+    exported = jax_export.deserialize(blob["hlo"])
     return _LoadedProgram(exported, blob["feed_names"], blob["n_fetch"])
 
 

@@ -1,0 +1,78 @@
+"""Deterministic tiny training under ResilientLoop — the chaos-suite
+workload (tests/test_fault_tolerance.py).
+
+Config via env: FT_CKPT_DIR (required), FT_STEPS, FT_SAVE_EVERY,
+FT_KEEP_LAST, FT_WATCHDOG (seconds), FT_OUT (write a JSON of sha256
+digests of final params/optimizer/RNG state — the bitwise-identity
+oracle).  Fault injection rides the standard PADDLE_TPU_FT_* env
+(fault_tolerance/injection.py).
+
+Determinism contract: the batch for step N is keyed on N alone, and
+dropout consumes the global RNG stream — so any resume that restores
+params + optimizer + RNG exactly reproduces an uninterrupted run bit for
+bit, and any resume that misses one of them diverges.
+"""
+import hashlib
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fault_tolerance import ResilientLoop
+
+
+def digest(arr):
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def main():
+    ckpt_dir = os.environ["FT_CKPT_DIR"]
+    steps = int(os.environ.get("FT_STEPS", "8"))
+    save_every = int(os.environ.get("FT_SAVE_EVERY", "2"))
+    keep_last = int(os.environ.get("FT_KEEP_LAST", "3"))
+    wd = os.environ.get("FT_WATCHDOG")
+
+    paddle.seed(1234)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+
+    def batch_for(step):
+        rs = np.random.RandomState(1000 + step)
+        return paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+
+    def step_fn(step):
+        x = batch_for(step)
+        y = F.dropout(net(x), p=0.25, training=True)
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    loop = ResilientLoop(
+        ckpt_dir,
+        state_fn=lambda: {"model": net.state_dict(),
+                          "opt": opt.state_dict()},
+        restore_fn=lambda s: (net.set_state_dict(s["model"]),
+                              opt.set_state_dict(s["opt"])),
+        save_every=save_every, keep_last=keep_last,
+        watchdog_timeout=float(wd) if wd else None)
+    loop.run(step_fn, steps)
+
+    out = os.environ.get("FT_OUT")
+    if out:
+        final = {f"model/{k}": digest(np.asarray(v.numpy()))
+                 for k, v in net.state_dict().items()}
+        for k, v in opt.state_dict().items():
+            final[f"opt/{k}"] = (digest(np.asarray(v.numpy()))
+                                 if hasattr(v, "numpy") else v)
+        final["rng"] = digest(np.asarray(paddle.get_rng_state().numpy()))
+        with open(out, "w") as f:
+            json.dump(final, f, indent=1, sort_keys=True)
+
+
+if __name__ == "__main__":
+    main()

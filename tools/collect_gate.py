@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Collection gate: fail CI when any test module errors at import.
+
+Why this exists: between r05 and PR 2, a single version-fragile import
+(``from jax import shard_map``) errored **45 of 45** test modules at
+collection — and the suite "ran" anyway, reporting the handful of tests
+that still collected.  A green-ish run that silently lost 98% of its
+tests is worse than a red one.  This gate runs ``pytest --collect-only``
+and exits nonzero on ANY collection error, so an import break can never
+again zero out the suite unnoticed.
+
+Usage::
+
+    python tools/collect_gate.py [pytest-target ...]   # default: tests/
+
+Exit codes: 0 = everything collects; 1 = collection errors (listed on
+stderr); pytest's own exit code for other failures (usage error etc.).
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    targets = list(argv if argv is not None else sys.argv[1:]) or ["tests/"]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "--continue-on-collection-errors", "-p", "no:cacheprovider",
+         *targets],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    out = r.stdout + r.stderr
+    errors = re.findall(r"^ERROR (\S+)", out, flags=re.M)
+    m = re.search(r"(\d+) tests? collected", out)
+    collected = int(m.group(1)) if m else 0
+    if errors:
+        print(f"collect_gate: FAIL — {len(errors)} module(s) error at "
+              f"collection ({collected} tests still collect):",
+              file=sys.stderr)
+        for mod in errors:
+            print(f"  ERROR {mod}", file=sys.stderr)
+        # surface the first traceback block for diagnosis
+        tb = re.search(r"_{10,} ERROR collecting .*?(?=_{10,}|=+ )", out,
+                       flags=re.S)
+        if tb:
+            print(tb.group(0)[:4000], file=sys.stderr)
+        return 1
+    if collected == 0:
+        print("collect_gate: FAIL — zero tests collected "
+              "(wrong target or pytest broke before collection):",
+              file=sys.stderr)
+        print(out[-2000:], file=sys.stderr)
+        return 1
+    print(f"collect_gate: OK — {collected} tests collect, 0 errors")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
