@@ -98,10 +98,11 @@ def preflight(max_attempts=None, timeouts=None, backoffs=None):
                     f"attempts (last: {last})")
 
 
-def fail_structured(msg: str):
+def fail_structured(msg: str,
+                    metric: str = "gpt2_345m_train_tokens_per_sec_per_chip"):
     """One JSON line on stdout even on failure, then nonzero exit."""
     print(json.dumps({
-        "metric": "gpt2_345m_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": 0.0,
         "unit": "tokens/s",
         "vs_baseline": 0.0,
@@ -193,6 +194,45 @@ def build_bench(smoke: bool = False):
     return make_step, cfg, seq, model
 
 
+def serving_main():
+    """Serving smoke bench: continuous-batching decode throughput + TTFT
+    on the tiny GPT config (ISSUE 3).  Same one-JSON-line contract as the
+    training bench, selected via ``--serving`` /
+    ``PADDLE_TPU_BENCH_MODE=serving``.  ``vs_baseline`` is 1.0 — there is
+    no external baseline for this metric yet; the absolute fields
+    (``value``, ``ttft_ms``) are the tracked quantities."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt_tiny, GPTForCausalLM
+    from paddle_tpu.serving import Engine
+
+    paddle.seed(0)
+    eng = Engine(GPTForCausalLM(gpt_tiny()), num_slots=4, max_seq=64,
+                 min_bucket=8)
+    eng.warmup()
+    rs = np.random.RandomState(0)
+    lengths = [5, 13, 21, 34, 9, 17, 48, 3, 27, 11, 40, 6]
+    prompts = [rs.randint(0, 128, (L,)).tolist() for L in lengths]
+    eng.generate(prompts, max_new_tokens=12)
+    st = eng.stats()
+    if st["compile_cache"]["misses"] != len(eng.buckets) + 1:
+        fail_structured(
+            f"steady-state recompile detected: {st['compile_cache']}",
+            metric="serving_gpt_tiny_decode_tokens_per_sec")
+    print(json.dumps({
+        "metric": "serving_gpt_tiny_decode_tokens_per_sec",
+        "value": st["decode_tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "ttft_ms": st["ttft_ms"]["p50"],
+        "ttft_p99_ms": st["ttft_ms"]["p99"],
+        "inter_token_ms": st["inter_token_ms"]["p50"],
+        "requests_completed": st["requests"]["completed"],
+        "slot_occupancy": st["slot_occupancy"],
+        "compile_misses": st["compile_cache"]["misses"],
+    }))
+
+
 def main():
     import os
     import jax
@@ -272,12 +312,17 @@ if __name__ == "__main__":
               file=sys.stderr)
     else:
         preflight()
+    _serving = "--serving" in sys.argv or \
+        os.environ.get("PADDLE_TPU_BENCH_MODE") == "serving"
     try:
-        main()
+        serving_main() if _serving else main()
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 — structured failure contract
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        fail_structured(f"{type(e).__name__}: {e}")
+        fail_structured(
+            f"{type(e).__name__}: {e}",
+            metric="serving_gpt_tiny_decode_tokens_per_sec" if _serving
+            else "gpt2_345m_train_tokens_per_sec_per_chip")

@@ -25,7 +25,7 @@ __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "DataType", "PredictorPool", "get_version",
            "get_num_bytes_of_data_type", "get_trt_compile_version",
            "get_trt_runtime_version",
-           "PrecisionType", "PlaceType"]
+           "PrecisionType", "PlaceType", "create_engine"]
 
 
 class PrecisionType:
@@ -134,13 +134,19 @@ class Tensor:
 
 
 class Predictor:
-    """Reference: AnalysisPredictor via create_predictor."""
+    """Reference: AnalysisPredictor via create_predictor.
 
-    def __init__(self, config: Config):
+    ``_shared_layer`` lets PredictorPool hand every slot the SAME loaded
+    executable (TranslatedLayer is stateless across runs) instead of each
+    slot re-deserializing the artifact.
+    """
+
+    def __init__(self, config: Config, _shared_layer=None):
         self.config = config
         if not config.model_dir():
             raise ValueError("Config needs a model path (jit.save artifact)")
-        self._layer = jit_mod.load(config.model_dir())
+        self._layer = _shared_layer if _shared_layer is not None \
+            else jit_mod.load(config.model_dir())
         # the export's input tree is ((state_leaves, input_leaves), kwargs);
         # the model-input count is the second child's leaf count
         n_in = 1
@@ -150,16 +156,39 @@ class Predictor:
             n_in = args_td.children()[1].num_leaves
         except Exception:
             pass
-        self._input_names = [f"x{i}" for i in range(max(n_in, 1))]
+        self._input_names = self._load_input_names(max(n_in, 1))
         self._inputs: Dict[str, Tensor] = {
             n: Tensor(n) for n in self._input_names}
         self._outputs: List[Tensor] = []
+
+    def _load_input_names(self, n_in: int) -> List[str]:
+        """Real input names from the artifact's signature sidecar
+        (jit.save writes ``<path>.pdmeta.json`` with the InputSpec names);
+        artifacts predating the sidecar fall back to synthesized xN."""
+        import json
+
+        meta_path = self.config.model_dir() + ".pdmeta.json"
+        try:
+            with open(meta_path) as f:
+                names = list(json.load(f)["input_names"])
+            if names and len(names) == n_in and \
+                    all(isinstance(n, str) and n for n in names) and \
+                    len(set(names)) == len(names):
+                return names
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        return [f"x{i}" for i in range(n_in)]
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
 
     def get_input_handle(self, name: str) -> Tensor:
-        return self._inputs[name]
+        try:
+            return self._inputs[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown input {name!r}; this predictor's inputs are "
+                f"{self._input_names}") from None
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Reference run(): either pass arrays directly, or use the
@@ -236,12 +265,31 @@ def get_trt_runtime_version():
 class PredictorPool:
     """N independent predictors over one artifact (reference
     paddle_infer.PredictorPool; here each slot shares the loaded
-    executable, which is stateless)."""
+    executable, which is stateless).
+
+    The artifact is deserialized ONCE: the first slot loads it and every
+    further slot reuses that TranslatedLayer (each slot keeps its own
+    named input/output handles, which is the per-slot mutable state).
+    """
 
     def __init__(self, config: Config, size: int = 1):
-        self._preds = [Predictor(config) for _ in range(max(int(size), 1))]
+        first = Predictor(config)
+        self._preds = [first] + [
+            Predictor(config, _shared_layer=first._layer)
+            for _ in range(max(int(size), 1) - 1)]
 
     def retrive(self, idx: int) -> Predictor:   # reference spells it this way
         return self._preds[idx]
 
     retrieve = retrive
+
+
+def create_engine(config, **engine_kwargs):
+    """Continuous-batching serving entry (see ``paddle_tpu.serving``):
+    builds a ``serving.Engine`` from a model config (``GPTConfig`` /
+    ``LlamaConfig``), a registry name like ``"gpt:tiny"``, or a model
+    Layer.  The one-shot ``Predictor`` path above serves jit.save
+    artifacts; this path serves live models with KV-cache decode."""
+    from ..serving import Engine
+
+    return Engine.from_config(config, **engine_kwargs)

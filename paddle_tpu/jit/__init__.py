@@ -284,6 +284,26 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdmodel", "wb") as f:
         f.write(blob)
     _fsave({n: state[n] for n in names}, path + ".pdiparams")
+    # signature sidecar: real input names (InputSpec.name, else xN) so the
+    # serving surface (inference.Predictor) can expose named handles
+    # instead of synthesizing them; old artifacts without it still load
+    import json as _json
+
+    in_names = [(getattr(s, "name", None) or f"x{i}")
+                for i, s in enumerate(input_spec)]
+    meta = {
+        "format": 1,
+        "input_names": in_names,
+        "inputs": [
+            {"name": name,
+             "shape": [None if (d_ is None or (isinstance(d_, int) and d_ < 0))
+                       else int(d_)
+                       for d_ in getattr(s, "shape", list(arr.shape))],
+             "dtype": str(np.dtype(arr.dtype))}
+            for name, s, arr in zip(in_names, input_spec, in_arrays)],
+    }
+    with open(path + ".pdmeta.json", "w") as f:
+        _json.dump(meta, f, indent=1)
     if net is not None and was_training:
         net.train()
 

@@ -38,6 +38,7 @@ from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.norm import LayerNorm
 from ..ops.pallas import flash_attention as _flash_attention
+from ..ops.cached_attention import cached_attention as _cached_attention
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
@@ -114,15 +115,24 @@ class GPTAttention(Layer):
             h, h, weight_attr=init, input_is_parallel=True)
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache_ctx=None):
         B, S, _ = x.shape
         qkv = self.qkv_proj(x)                                  # [B,S,3h]/mp
         qkv = qkv.reshape([B, S, self.n_heads, 3 * self.head_dim])
         qkv = mark_sharding(qkv, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
         q, k, v = qkv.split(3, axis=-1)                         # [B,S,H,D]
-        ctx = _flash_attention(
-            q, k, v, dropout_p=self.dropout_p, is_causal=True,
-            training=self.training)
+        if cache_ctx is None:
+            ctx = _flash_attention(
+                q, k, v, dropout_p=self.dropout_p, is_causal=True,
+                training=self.training)
+        elif cache_ctx.mode == "prefill":
+            # prompt forward is ordinary causal attention; K/V land in the
+            # cache so decode can extend the sequence one token at a time
+            cache_ctx.write_prefill(k, v)
+            ctx = _flash_attention(q, k, v, is_causal=True, training=False)
+        else:                                   # decode: S == 1 per slot
+            k_full, v_full, lens = cache_ctx.write_decode(k, v)
+            ctx = _cached_attention(q, k_full, v_full, lens)
         ctx = mark_sharding(ctx, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
         ctx = ctx.reshape([B, S, self.n_heads * self.head_dim])
         return self.out_proj(ctx)
@@ -157,8 +167,8 @@ class GPTDecoderLayer(Layer):
         self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln1(x)))
+    def forward(self, x, cache_ctx=None):
+        x = x + self.dropout(self.attn(self.ln1(x), cache_ctx))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return mark_sharding(x, _act_spec())
 
@@ -198,10 +208,17 @@ class GPTModel(Layer):
         self.final_ln = LayerNorm(config.hidden_size,
                                   epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, cache_ctx=None):
+        if cache_ctx is not None and cache_ctx.mode == "decode" \
+                and position_ids is None:
+            # each slot's single token sits at that slot's own offset
+            position_ids = cache_ctx.positions()
         h = self.embeddings(input_ids, position_ids)
-        for layer in self.layers:
-            if self.config.recompute and self.training:
+        for i, layer in enumerate(self.layers):
+            if cache_ctx is not None:
+                cache_ctx.layer_idx = i
+                h = layer(h, cache_ctx)
+            elif self.config.recompute and self.training:
                 h = recompute(layer, h)
             else:
                 h = layer(h)
@@ -223,8 +240,8 @@ class GPTForCausalLM(Layer):
         else:
             self.lm_head = None
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, cache_ctx=None):
+        h = self.gpt(input_ids, position_ids, cache_ctx=cache_ctx)
         if self.lm_head is not None:
             logits = self.lm_head(h)
         else:

@@ -40,6 +40,7 @@ from ..nn.layer.container import LayerList
 from ..nn.layer.norm import RMSNorm
 from ..ops.pallas import flash_attention as _flash_attention
 from ..ops.pallas import rotary_embedding as _rotary_embedding
+from ..ops.cached_attention import cached_attention as _cached_attention
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
 )
@@ -162,7 +163,7 @@ class LlamaAttention(Layer):
         self.max_pos = config.max_position_embeddings
         self._rope = None  # built lazily at first forward
 
-    def forward(self, x):
+    def forward(self, x, cache_ctx=None):
         B, S, _ = x.shape
         q = self.q_proj(x).reshape([B, S, self.n_heads, self.head_dim])
         kv = self.kv_proj(x).reshape([B, S, self.n_kv, 2 * self.head_dim])
@@ -173,19 +174,35 @@ class LlamaAttention(Layer):
         if self._rope is None or self._rope[0].shape[0] < S:
             self._rope = _rope_cache(max(S, self.max_pos), self.head_dim,
                                      self.rope_theta)
-        cos = Tensor._wrap(jnp.asarray(self._rope[0][:S]))
-        sin = Tensor._wrap(jnp.asarray(self._rope[1][:S]))
-        q, k = _rotary_embedding(q, k, cos, sin)
+        if cache_ctx is not None and cache_ctx.mode == "decode":
+            # position-offset rotary: gather the FULL tables at each slot's
+            # current offset (the single query token is not at position 0)
+            cos = Tensor._wrap(jnp.asarray(self._rope[0]))
+            sin = Tensor._wrap(jnp.asarray(self._rope[1]))
+            q, k = _rotary_embedding(q, k, cos, sin,
+                                     position_ids=cache_ctx.positions())
+            # cache stores post-rotary K (and V) at kv-head granularity
+            k_full, v_full, lens = cache_ctx.write_decode(k, v)
+            ctx = _cached_attention(q, k_full, v_full, lens)
+        else:
+            cos = Tensor._wrap(jnp.asarray(self._rope[0][:S]))
+            sin = Tensor._wrap(jnp.asarray(self._rope[1][:S]))
+            q, k = _rotary_embedding(q, k, cos, sin)
 
-        if self.n_kv != self.n_heads:
-            rep = self.n_heads // self.n_kv
-            k = k.unsqueeze(3).expand([B, S, self.n_kv, rep, self.head_dim]) \
-                 .reshape([B, S, self.n_heads, self.head_dim])
-            v = v.unsqueeze(3).expand([B, S, self.n_kv, rep, self.head_dim]) \
-                 .reshape([B, S, self.n_heads, self.head_dim])
+            if cache_ctx is not None:                   # prefill
+                cache_ctx.write_prefill(k, v)
 
-        ctx = _flash_attention(q, k, v, is_causal=True,
-                               training=self.training)
+            if self.n_kv != self.n_heads:
+                rep = self.n_heads // self.n_kv
+                k = k.unsqueeze(3) \
+                     .expand([B, S, self.n_kv, rep, self.head_dim]) \
+                     .reshape([B, S, self.n_heads, self.head_dim])
+                v = v.unsqueeze(3) \
+                     .expand([B, S, self.n_kv, rep, self.head_dim]) \
+                     .reshape([B, S, self.n_heads, self.head_dim])
+
+            ctx = _flash_attention(q, k, v, is_causal=True,
+                                   training=self.training)
         ctx = mark_sharding(ctx, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
         ctx = ctx.reshape([B, S, self.n_heads * self.head_dim])
         return self.o_proj(ctx)
@@ -224,8 +241,9 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = x + self.dropout(self.self_attn(self.input_layernorm(x)))
+    def forward(self, x, cache_ctx=None):
+        x = x + self.dropout(
+            self.self_attn(self.input_layernorm(x), cache_ctx))
         x = x + self.dropout(self.mlp(self.post_attention_layernorm(x)))
         return mark_sharding(x, _act_spec())
 
@@ -242,10 +260,13 @@ class LlamaModel(Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache_ctx=None):
         h = mark_sharding(self.embed_tokens(input_ids), _act_spec())
-        for layer in self.layers:
-            if self.config.recompute and self.training:
+        for i, layer in enumerate(self.layers):
+            if cache_ctx is not None:
+                cache_ctx.layer_idx = i
+                h = layer(h, cache_ctx)
+            elif self.config.recompute and self.training:
                 h = recompute(layer, h)
             else:
                 h = layer(h)
@@ -266,8 +287,8 @@ class LlamaForCausalLM(Layer):
                                   bias_attr=False)
             set_param_spec(self.lm_head.weight, P(None, MODEL_AXIS))
 
-    def forward(self, input_ids):
-        h = self.llama(input_ids)
+    def forward(self, input_ids, cache_ctx=None):
+        h = self.llama(input_ids, cache_ctx=cache_ctx)
         if self.lm_head is not None:
             logits = self.lm_head(h)
         else:

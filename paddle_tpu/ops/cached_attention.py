@@ -1,0 +1,62 @@
+"""Decode-step attention against a slot-paged KV cache.
+
+Serving counterpart of ``ops.pallas.flash_attention``: during continuous-
+batching decode every sequence contributes exactly ONE query token, and the
+keys/values live in a preallocated fixed-shape cache (``serving.KVCache``),
+so the kernel is a masked single-row attention over ``[B, T, Hkv, D]``
+where T is the cache capacity.  Static shapes are the point: the same
+compiled executable serves every step of every request (XLA recompiles on
+any new shape — FlashFuser-style fused decode attention assumes exactly
+this fixed-layout cache).
+
+GQA is handled inside the kernel: ``Hkv`` may divide ``H`` and kv heads are
+repeated consecutively (kv head ``h // (H // Hkv)`` serves query head
+``h``), matching the models' no-cache expand path bit-for-bit.
+
+The XLA formulation below is the oracle/CPU path; on TPU it is already a
+single fused masked-softmax-matmul under jit, and the layout is chosen so a
+Pallas kernel can slot in behind the same signature later.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+__all__ = ["cached_attention"]
+
+
+def cached_attention(query, k_cache, v_cache, lengths, name=None):
+    """One decode step of attention for a batch of cache slots.
+
+    Args:
+        query:   ``[B, 1, H, D]`` — the current token's projected queries.
+        k_cache: ``[B, T, Hkv, D]`` — per-slot key cache (one layer),
+                 positions ``0..lengths[b]`` valid (current token included:
+                 the caller writes the new K/V *before* attending).
+        v_cache: ``[B, T, Hkv, D]`` — per-slot value cache.
+        lengths: ``[B]`` int32 — index of the current token per slot; the
+                 attention window is ``0..lengths[b]`` inclusive.
+
+    Returns:
+        ``[B, 1, H, D]`` context tensor.
+    """
+
+    def _primal(q, k, v, ln):
+        B, Sq, H, D = q.shape
+        T, Hkv = k.shape[1], k.shape[2]
+        if Hkv != H:
+            rep = H // Hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scale = 1.0 / (D ** 0.5)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        logits = logits.astype(jnp.float32)
+        valid = jnp.arange(T, dtype=ln.dtype)[None, :] <= ln[:, None]  # [B,T]
+        logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    return apply_op("cached_attention", _primal,
+                    [query, k_cache, v_cache, lengths])

@@ -149,11 +149,29 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
 
 
 def rotary_embedding(q, k, cos, sin, position_ids=None):
-    """Apply rotary position embedding to q/k ([B, S, H, D])."""
+    """Apply rotary position embedding to q/k ([B, S, H, D]).
+
+    ``position_ids`` (``[B, S]`` int, optional) selects per-token rows of
+    the cos/sin tables instead of assuming positions ``0..S-1`` — the
+    position-offset path KV-cache decode needs (each slot's single query
+    token sits at that slot's own sequence offset).
+    """
 
     def _rot(x):
         x1, x2 = jnp.split(x, 2, axis=-1)
         return jnp.concatenate([-x2, x1], axis=-1)
+
+    if position_ids is not None:
+        def _primal_pos(qa, ka, c, s, pos):
+            # c/s: [T, D] tables gathered at pos [B, S] → [B, S, 1, D]
+            c_b = c[pos][:, :, None, :]
+            s_b = s[pos][:, :, None, :]
+            q_out = qa * c_b + _rot(qa) * s_b
+            k_out = ka * c_b + _rot(ka) * s_b
+            return q_out, k_out
+
+        return apply_op("rotary_embedding", _primal_pos,
+                        [q, k, cos, sin, position_ids], n_outs=2)
 
     def _primal(qa, ka, c, s):
         # c/s: [S, D] → broadcast over batch/heads

@@ -35,6 +35,34 @@ from typing import Callable, Iterable, Optional, Union
 from .statistic import StatisticData, SortedKeys  # noqa: F401
 from .timer import benchmark  # noqa: F401
 
+# -- serving metrics export -------------------------------------------------
+# Live serving.Engine instances register their ServingMetrics here (weakly:
+# an engine going away must not leak through the profiler); serving_stats()
+# is the process-wide /stats aggregation point.
+import weakref as _weakref
+
+_serving_metrics: "list" = []
+
+
+def _register_serving_metrics(m) -> None:
+    _serving_metrics.append(_weakref.ref(m))
+
+
+def serving_stats() -> dict:
+    """Snapshot of every live serving engine's metrics, keyed by engine
+    name (TTFT, inter-token latency, tokens/sec, queue depth, slot
+    occupancy, compile-cache hits/misses — see serving.ServingMetrics)."""
+    out = {}
+    live = []
+    for ref in _serving_metrics:
+        m = ref()
+        if m is None:
+            continue
+        live.append(ref)
+        out[m.name] = m.snapshot()
+    _serving_metrics[:] = live
+    return out
+
 
 class ProfilerState(enum.Enum):
     """Reference: profiler.py ProfilerState (:34)."""

@@ -1,0 +1,23 @@
+"""paddle_tpu.serving — the LLM serving engine.
+
+A slot-based continuous-batching serving stack for the flagship causal-LM
+families (``models.GPTForCausalLM`` / ``models.LlamaForCausalLM``):
+
+- :class:`KVCache` — preallocated ``[slots, layers, max_seq, kv_heads,
+  head_dim]`` key/value storage with per-slot length tracking;
+- :class:`Engine` — request queue + slot scheduler, bucketed prefill with a
+  compiled-executable cache (zero steady-state recompiles), greedy /
+  temperature sampling, per-token streaming callbacks;
+- :class:`ServingMetrics` — TTFT / inter-token latency / tokens-per-sec /
+  queue depth / slot occupancy / compile-cache counters, exported as a
+  ``/stats``-style dict and via ``paddle_tpu.profiler.serving_stats()``.
+
+See ``docs/SERVING.md`` for the architecture and an end-to-end example.
+"""
+from .kv_cache import KVCache, CacheContext  # noqa: F401
+from .sampling import SamplingParams, sample  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .engine import Engine, Request  # noqa: F401
+
+__all__ = ["KVCache", "CacheContext", "Engine", "Request",
+           "SamplingParams", "ServingMetrics", "sample"]

@@ -1,0 +1,389 @@
+"""Continuous-batching serving engine: slot scheduler over a KV cache.
+
+The TPU-idiomatic serving loop (XLA recompiles on every new shape, so the
+engine is built so that NO shape ever depends on request content):
+
+- **prefill**: each admitted request's prompt is padded to a power-of-two
+  bucket and run through the model's causal forward once, writing K/V into
+  the request's slot.  One executable per bucket; the slot index and true
+  prompt length are *arguments*, so all slots share the executables.
+- **decode**: every step runs ONE fixed-shape program over all slots
+  (``[slots, 1]`` tokens + ``[slots]`` active mask), each active slot
+  extending its sequence by one token via ``ops.cached_attention``.
+  Admitting or retiring a request only changes argument *values* —
+  steady-state serving triggers zero recompiles (asserted by tests via the
+  executable cache's own hit/miss counters).
+
+Requests are admitted into free slots as they arrive and retired the step
+they finish (eos / token budget / cache capacity), in the spirit of
+fine-grained compute/host-scheduling overlap (T3, arXiv:2401.16677) —
+host-side sampling and scheduling happen while the next step's arguments
+are assembled.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from .kv_cache import KVCache, CacheContext
+from .metrics import ServingMetrics
+from .sampling import SamplingParams, sample
+
+__all__ = ["Engine", "Request", "SamplingParams"]
+
+_engine_counter = itertools.count()
+
+
+@dataclass(eq=False)           # a live handle: identity, not field equality
+class Request:
+    """One generation request moving through the engine."""
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int = 16
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_id: Optional[int] = None
+    stream_cb: Optional[Callable[[int, "Request"], None]] = None
+    request_id: int = -1
+
+    # lifecycle (engine-managed)
+    state: str = "queued"            # queued | running | finished
+    slot: Optional[int] = None
+    output_ids: List[int] = field(default_factory=list)
+    prefill_bucket: int = 0
+    t_enqueue: float = 0.0
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    _rng: Optional[np.random.RandomState] = None
+    _seq_len: int = 0                # prompt + emitted tokens in the cache
+
+    @property
+    def finished(self) -> bool:
+        return self.state == "finished"
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_enqueue
+
+    def _emit(self, token: int, now: float) -> None:
+        if self.t_first_token is None:
+            self.t_first_token = now
+        self.output_ids.append(int(token))
+        if self.stream_cb is not None:
+            self.stream_cb(int(token), self)
+
+
+class Engine:
+    """Slot-based continuous-batching engine over a causal-LM model.
+
+    Args:
+        model: ``GPTForCausalLM`` / ``LlamaForCausalLM`` (any Layer whose
+            forward accepts ``cache_ctx`` works).  Switched to eval mode.
+        num_slots: fixed decode batch width.
+        max_seq: per-slot cache capacity (prompt + generated); defaults to
+            the model's ``max_position_embeddings``.
+        min_bucket: smallest prefill bucket; buckets are powers of two up
+            to ``max_seq``.
+        cache_dtype: KV cache dtype (default: the model's param dtype).
+    """
+
+    def __init__(self, model, *, num_slots: int = 4,
+                 max_seq: Optional[int] = None, min_bucket: int = 8,
+                 cache_dtype=None, name: Optional[str] = None):
+        cfg = getattr(model, "config", None)
+        if cfg is None:
+            raise TypeError("Engine needs a model carrying a .config "
+                            "(GPTForCausalLM / LlamaForCausalLM)")
+        self.model = model
+        self.model.eval()
+        self.config = cfg
+        max_pos = getattr(cfg, "max_position_embeddings", None)
+        if max_seq is None and max_pos is None:
+            raise ValueError("max_seq is required: the model config has no "
+                             "max_position_embeddings to default to")
+        self.max_seq = int(max_seq or max_pos)
+        if max_pos is not None and self.max_seq > max_pos:
+            raise ValueError(
+                f"max_seq {self.max_seq} exceeds the model's "
+                f"max_position_embeddings {max_pos}")
+        self.num_slots = int(num_slots)
+        self.min_bucket = int(min_bucket)
+        if self.min_bucket < 1:
+            raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+        self.buckets = self._make_buckets()
+        kv_heads = getattr(cfg, "n_kv_heads", None) or cfg.num_attention_heads
+        if cache_dtype is None:
+            params = model.parameters()
+            cache_dtype = params[0].dtype if params else "float32"
+        self.cache = KVCache(
+            num_slots=self.num_slots, num_layers=cfg.num_hidden_layers,
+            max_seq=self.max_seq, num_kv_heads=kv_heads,
+            head_dim=cfg.head_dim, dtype=cache_dtype)
+        self.name = name or f"engine-{next(_engine_counter)}"
+        self.metrics = ServingMetrics(self.name, num_slots=self.num_slots)
+        self.queue: deque = deque()
+        self.running: Dict[int, Request] = {}
+        self.free_slots: List[int] = list(range(self.num_slots))
+        self._last_token = np.zeros((self.num_slots,), dtype=np.int64)
+        self._req_counter = itertools.count()
+        self._prefill_fn = None
+        self._decode_fn = None
+
+    # -- compiled steps ----------------------------------------------------
+
+    def _make_buckets(self) -> List[int]:
+        b, out = self.min_bucket, []
+        while b < self.max_seq:
+            out.append(b)
+            b *= 2
+        out.append(self.max_seq)
+        return out
+
+    def bucket_for(self, prompt_len: int) -> int:
+        if prompt_len > self.max_seq:
+            raise ValueError(f"prompt length {prompt_len} exceeds cache "
+                             f"capacity max_seq={self.max_seq}")
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        return self.max_seq
+
+    def _build_steps(self) -> None:
+        """Compile-cached prefill/decode programs.  Built lazily so the
+        engine can be constructed before any backend is touched."""
+        from .. import jit as jit_mod
+
+        model, cache = self.model, self.cache
+
+        def prefill_step(input_ids, slot, length):
+            ctx = CacheContext(cache, "prefill", slot=slot, length=length)
+            logits = model(input_ids, cache_ctx=ctx)
+            cache.set_length(slot, length)
+            arr = logits._value()                       # [1, S, V]
+            last = jax.lax.dynamic_index_in_dim(
+                arr[0], length._value().astype(jnp.int32) - 1,
+                axis=0, keepdims=False)
+            return Tensor._wrap(last.astype(jnp.float32))
+
+        def decode_step(tokens, active):
+            ctx = CacheContext(cache, "decode", active=active)
+            logits = model(tokens, cache_ctx=ctx)
+            cache.advance(active)
+            return Tensor._wrap(
+                logits._value()[:, -1, :].astype(jnp.float32))
+
+        self._prefill_fn = jit_mod.to_static(prefill_step)
+        self._decode_fn = jit_mod.to_static(decode_step)
+
+    def _call_counted(self, fn, *args):
+        """Run a compiled step, feeding the executable cache's own state
+        into the hit/miss counters (a new program in the cache == one XLA
+        compile == one miss)."""
+        from ..core.autograd import no_grad
+
+        before = len(fn.program_cache)
+        with no_grad():
+            out = fn(*args)
+        self.metrics.on_compile(miss=len(fn.program_cache) > before)
+        return out
+
+    # -- public API --------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, config, **engine_kwargs) -> "Engine":
+        """Predictor-compatible entry: build an Engine from a model config
+        (``GPTConfig``/``LlamaConfig``), a registry name (``"gpt:tiny"``,
+        ``"llama:llama2-7b"``), or a ready model Layer."""
+        from ..nn.layer_base import Layer
+        from ..models import (
+            GPT_CONFIGS, GPTConfig, GPTForCausalLM,
+            LLAMA_CONFIGS, LlamaConfig, LlamaForCausalLM,
+        )
+
+        if isinstance(config, Layer):
+            return cls(config, **engine_kwargs)
+        if isinstance(config, GPTConfig):
+            return cls(GPTForCausalLM(config), **engine_kwargs)
+        if isinstance(config, LlamaConfig):
+            return cls(LlamaForCausalLM(config), **engine_kwargs)
+        if isinstance(config, str):
+            family, _, which = config.partition(":")
+            reg = {"gpt": (GPT_CONFIGS, GPTForCausalLM),
+                   "llama": (LLAMA_CONFIGS, LlamaForCausalLM)}.get(family)
+            if reg is None or (which or "tiny") not in reg[0]:
+                raise KeyError(
+                    f"unknown model spec {config!r}; want "
+                    f"'gpt:<{'|'.join(GPT_CONFIGS)}>' or "
+                    f"'llama:<{'|'.join(LLAMA_CONFIGS)}>'")
+            cfgs, cls_ = reg
+            return cls(cls_(cfgs[which or "tiny"]()), **engine_kwargs)
+        raise TypeError(
+            f"Engine.from_config: unsupported config {type(config).__name__}"
+            " — pass a GPTConfig/LlamaConfig, a 'family:size' name, or a "
+            "model Layer.  (jit.save artifacts have no cache-aware forward;"
+            " serve those through inference.Predictor instead.)")
+
+    def add_request(self, prompt_ids: Sequence[int], *,
+                    max_new_tokens: int = 16,
+                    sampling: Optional[SamplingParams] = None,
+                    temperature: Optional[float] = None,
+                    eos_token_id: Optional[int] = None,
+                    stream_cb: Optional[Callable] = None) -> Request:
+        """Enqueue a prompt; it is admitted into a slot by a later
+        ``step()``.  Returns the live Request handle."""
+        prompt = np.asarray(list(prompt_ids), dtype=np.int64).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size > self.max_seq:
+            raise ValueError(f"prompt length {prompt.size} exceeds "
+                             f"max_seq={self.max_seq}")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if sampling is None:
+            sampling = SamplingParams(temperature=temperature or 0.0)
+        req = Request(prompt_ids=prompt, max_new_tokens=int(max_new_tokens),
+                      sampling=sampling, eos_token_id=eos_token_id,
+                      stream_cb=stream_cb,
+                      request_id=next(self._req_counter))
+        req.t_enqueue = time.perf_counter()
+        req._rng = np.random.RandomState(
+            sampling.seed if sampling.seed is not None
+            else (req.request_id + 1) * 7919)
+        self.queue.append(req)
+        self.metrics.on_enqueue(len(self.queue))
+        return req
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
+        """Pre-compile the decode step and every prefill bucket with dummy
+        traffic, then reset the cache — so live serving starts with a hot
+        executable cache and zero steady-state misses."""
+        if self.running or self.queue:
+            raise RuntimeError("warmup() must run before serving traffic "
+                               "(it scribbles over slot 0 and resets all "
+                               "slot lengths)")
+        if self._prefill_fn is None:
+            self._build_steps()
+        for b in (buckets or self.buckets):
+            ids = np.zeros((1, int(b)), dtype=np.int64)
+            self._call_counted(
+                self._prefill_fn, to_tensor(ids),
+                to_tensor(np.int32(0)), to_tensor(np.int32(1)))
+        toks = np.zeros((self.num_slots, 1), dtype=np.int64)
+        idle = np.zeros((self.num_slots,), dtype=np.int32)
+        self._call_counted(self._decode_fn, to_tensor(toks), to_tensor(idle))
+        self.cache.reset()
+        return {"buckets": list(buckets or self.buckets),
+                "compile_misses": self.metrics.compile_misses}
+
+    # -- scheduling --------------------------------------------------------
+
+    def _admit(self, req: Request, slot: int) -> None:
+        L = int(req.prompt_ids.size)
+        bucket = self.bucket_for(L)
+        ids = np.zeros((1, bucket), dtype=np.int64)
+        ids[0, :L] = req.prompt_ids
+        t0 = time.perf_counter()
+        last = self._call_counted(
+            self._prefill_fn, to_tensor(ids),
+            to_tensor(np.int32(slot)), to_tensor(np.int32(L)))
+        logits = last.numpy()
+        now = time.perf_counter()
+        self.metrics.prefill_time_s += now - t0
+        req.state, req.slot, req.prefill_bucket = "running", slot, bucket
+        req._seq_len = L
+        self.metrics.on_admit(bucket, L, len(self.queue))
+        tok = sample(logits, req.sampling, req._rng)
+        req._emit(tok, now)
+        self.metrics.on_first_token(req.ttft_s)
+        self.running[slot] = req
+        self._last_token[slot] = tok
+        if self._done_after_emit(req):
+            self._retire(req)
+
+    def _done_after_emit(self, req: Request) -> bool:
+        if len(req.output_ids) >= req.max_new_tokens:
+            return True
+        if req.eos_token_id is not None and \
+                req.output_ids[-1] == req.eos_token_id:
+            return True
+        # the NEXT decode would write at position _seq_len; the emitted
+        # token itself still needs a cache line to attend from
+        if req._seq_len + 1 > self.max_seq:
+            return True
+        return False
+
+    def _retire(self, req: Request) -> None:
+        slot = req.slot
+        req.state = "finished"
+        req.t_finish = time.perf_counter()
+        self.running.pop(slot, None)
+        self.free_slots.append(slot)
+        self.metrics.on_complete()
+
+    def _decode(self) -> None:
+        toks = np.zeros((self.num_slots, 1), dtype=np.int64)
+        active = np.zeros((self.num_slots,), dtype=np.int32)
+        for slot in self.running:
+            toks[slot, 0] = self._last_token[slot]
+            active[slot] = 1
+        t0 = time.perf_counter()
+        out = self._call_counted(
+            self._decode_fn, to_tensor(toks), to_tensor(active))
+        logits = out.numpy()                     # [slots, V]
+        now = time.perf_counter()
+        self.metrics.on_decode_step(len(self.running), now - t0)
+        for slot, req in list(self.running.items()):
+            req._seq_len += 1                    # token written this step
+            tok = sample(logits[slot], req.sampling, req._rng)
+            req._emit(tok, now)
+            self._last_token[slot] = tok
+            if self._done_after_emit(req):
+                self._retire(req)
+
+    def step(self) -> bool:
+        """One scheduler tick: admit queued requests into free slots, then
+        run one decode step for all running slots.  Returns True while
+        there is in-flight or queued work."""
+        if self._prefill_fn is None:
+            self._build_steps()
+        while self.free_slots and self.queue:
+            self._admit(self.queue.popleft(), self.free_slots.pop())
+        self.metrics.on_slots(len(self.running))
+        if self.running:
+            self._decode()
+        return bool(self.running or self.queue)
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive ``step()`` until idle (or ``max_steps``)."""
+        n = 0
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+
+    def generate(self, prompts: Sequence[Sequence[int]], *,
+                 max_new_tokens: int = 16, **request_kwargs
+                 ) -> List[List[int]]:
+        """Synchronous convenience: serve a batch of prompts through the
+        continuous-batching loop; returns generated ids per prompt."""
+        reqs = [self.add_request(p, max_new_tokens=max_new_tokens,
+                                 **request_kwargs) for p in prompts]
+        self.run()
+        return [r.output_ids for r in reqs]
+
+    def stats(self) -> dict:
+        """``/stats``-style snapshot (also exported through
+        ``paddle_tpu.profiler.serving_stats()``)."""
+        self.metrics._slots_busy = len(self.running)
+        self.metrics.queue_depth = len(self.queue)
+        return self.metrics.snapshot()

@@ -1,0 +1,177 @@
+"""Slot-paged KV cache for continuous-batching decode.
+
+Design (TPU-first): ONE preallocated array per K and V of shape
+``[slots, layers, max_seq, kv_heads, head_dim]`` plus a ``[slots]`` int32
+length vector.  Every shape the serving engine ever compiles is a function
+of (slots, bucket, max_seq) only — never of request content — so XLA
+compiles each program once and steady-state serving runs zero recompiles.
+
+State threading: the cache payloads are ordinary eager ``Tensor``s.  Inside
+a ``jit.to_static`` trace, reads go through ``Tensor._value`` (lifted to
+program inputs) and writes through ``Tensor._set_data`` (lifted to program
+outputs and rebound after the call) — exactly how optimizer accumulators
+thread through a compiled train step, so the cache needs no explicit
+functional plumbing and buffer donation updates it in place.
+
+Write discipline (why stale bytes are never read):
+- prefill writes positions ``0..bucket-1`` of a slot (garbage past the real
+  prompt length L) and sets ``lengths[slot] = L``;
+- decode writes each active slot's token at position ``lengths[slot]`` and
+  THEN advances ``lengths`` by the active mask;
+- attention only reads positions ``<= lengths[slot]`` (current token
+  included).  Every readable position was written by the current request,
+  so slot reuse needs no cache zeroing.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+
+__all__ = ["KVCache", "CacheContext"]
+
+
+def _as_i32(x):
+    if isinstance(x, Tensor):
+        return x._value().astype(jnp.int32)
+    return jnp.asarray(x, dtype=jnp.int32)
+
+
+class KVCache:
+    """Preallocated per-slot KV storage shared by all layers of one model.
+
+    Args:
+        num_slots:    fixed decode batch width (continuous-batching slots).
+        num_layers:   decoder layer count.
+        max_seq:      cache capacity per slot (prompt + generated tokens).
+        num_kv_heads: KV head count (``< num_heads`` under GQA).
+        head_dim:     per-head dimension.
+        dtype:        cache dtype (default float32; bf16 halves HBM).
+    """
+
+    def __init__(self, num_slots: int, num_layers: int, max_seq: int,
+                 num_kv_heads: int, head_dim: int, dtype="float32"):
+        if num_slots < 1 or num_layers < 1 or max_seq < 1:
+            raise ValueError("num_slots/num_layers/max_seq must be >= 1")
+        self.num_slots = int(num_slots)
+        self.num_layers = int(num_layers)
+        self.max_seq = int(max_seq)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        shape = (self.num_slots, self.num_layers, self.max_seq,
+                 self.num_kv_heads, self.head_dim)
+        self.k = Tensor._wrap(jnp.zeros(shape, dtype=self.dtype))
+        self.v = Tensor._wrap(jnp.zeros(shape, dtype=self.dtype))
+        self.lengths = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        for t in (self.k, self.v, self.lengths):
+            t.persistable = True
+
+    # -- serving-loop state ops (called inside OR outside a trace) --------
+
+    def prefill_write(self, layer_idx: int, slot, k, v) -> None:
+        """Write a whole prompt's K/V into one slot at positions 0..S-1.
+
+        ``k``/``v``: ``[1, S, Hkv, D]`` (S = prefill bucket ≤ max_seq);
+        ``slot``: scalar int (may be traced — one compiled prefill serves
+        every slot).
+        """
+        s = _as_i32(slot).reshape(())
+        li = jnp.int32(layer_idx)
+        zero = jnp.int32(0)
+        for buf, new in ((self.k, k), (self.v, v)):
+            arr = buf._value()
+            upd = new._value().astype(arr.dtype)[:, None]   # [1,1,S,Hkv,D]
+            arr = jax.lax.dynamic_update_slice(
+                arr, upd, (s, li, zero, zero, zero))
+            buf._set_data(arr)
+
+    def set_length(self, slot, length) -> None:
+        """Record a freshly prefilled slot's valid length (= prompt len)."""
+        s = _as_i32(slot).reshape(())
+        ln = _as_i32(length).reshape(())
+        self.lengths._set_data(self.lengths._value().at[s].set(ln))
+
+    def decode_write(self, layer_idx: int, k, v
+                     ) -> Tuple[Tensor, Tensor, Tensor]:
+        """Write one decode token per slot at that slot's current length.
+
+        ``k``/``v``: ``[slots, 1, Hkv, D]``.  Returns the post-write layer
+        caches ``[slots, max_seq, Hkv, D]`` and the pre-advance lengths
+        ``[slots]`` — exactly what ``ops.cached_attention`` consumes.
+        """
+        lens = self.lengths._value()
+        outs = []
+        for buf, new in ((self.k, k), (self.v, v)):
+            arr = buf._value()
+            layer = arr[:, layer_idx]                       # [slots,T,Hkv,D]
+            upd = new._value().astype(arr.dtype)            # [slots,1,Hkv,D]
+            layer = jax.vmap(
+                lambda c, u, p: jax.lax.dynamic_update_slice(
+                    c, u, (p, jnp.int32(0), jnp.int32(0))))(layer, upd, lens)
+            buf._set_data(arr.at[:, layer_idx].set(layer))
+            outs.append(Tensor._wrap(layer))
+        return outs[0], outs[1], Tensor._wrap(lens)
+
+    def advance(self, active) -> None:
+        """Grow lengths by one for active slots (call once per decode step,
+        after all layers have written)."""
+        mask = _as_i32(active)
+        self.lengths._set_data(self.lengths._value() + mask)
+
+    # -- host-side management ---------------------------------------------
+
+    def reset(self) -> None:
+        """Forget all sequences (lengths → 0).  Cache payloads are left as
+        is — the write discipline above makes stale bytes unreadable."""
+        self.lengths._set_data(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+
+    def length_of(self, slot: int) -> int:
+        return int(self.lengths.numpy()[slot])
+
+    def nbytes(self) -> int:
+        itemsize = jnp.zeros((), dtype=self.dtype).dtype.itemsize
+        return 2 * self.num_slots * self.num_layers * self.max_seq * \
+            self.num_kv_heads * self.head_dim * itemsize
+
+
+@dataclass
+class CacheContext:
+    """Per-forward-call routing handle threaded through model layers.
+
+    ``mode`` selects the path: ``"prefill"`` runs the normal causal forward
+    while writing K/V into ``slot``; ``"decode"`` runs single-token cached
+    attention for all slots at once.  ``layer_idx`` is advanced by the
+    model's layer loop (a per-trace python constant).  Models only duck-type
+    this object, keeping ``models/`` free of serving imports.
+    """
+
+    cache: KVCache
+    mode: str                                   # "prefill" | "decode"
+    slot: Optional[Tensor] = None               # prefill: scalar int32
+    length: Optional[Tensor] = None             # prefill: scalar int32
+    active: Optional[Tensor] = None             # decode: [slots] int32 mask
+    layer_idx: int = 0
+
+    def __post_init__(self):
+        if self.mode not in ("prefill", "decode"):
+            raise ValueError(f"CacheContext mode {self.mode!r} "
+                             "(want 'prefill' or 'decode')")
+
+    def write_prefill(self, k, v) -> None:
+        self.cache.prefill_write(self.layer_idx, self.slot, k, v)
+
+    def write_decode(self, k, v) -> Tuple[Tensor, Tensor, Tensor]:
+        return self.cache.decode_write(self.layer_idx, k, v)
+
+    def positions(self) -> Tensor:
+        """Current token positions ``[slots, 1]`` (pre-advance lengths) —
+        position ids for learned embeddings / rotary offsets in decode."""
+        return Tensor._wrap(self.cache.lengths._value()[:, None])

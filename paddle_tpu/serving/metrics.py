@@ -1,0 +1,143 @@
+"""Serving metrics: the observability layer of the serving engine.
+
+Counters and latency distributions a production deployment exports per
+engine: time-to-first-token (TTFT), inter-token latency (ITL), decode
+throughput, queue depth, slot occupancy, and the compile-executable cache
+hit/miss counters that back the zero-recompile steady-state guarantee.
+
+``snapshot()`` returns a ``/stats``-style plain dict (JSON-serializable).
+Each ``ServingMetrics`` registers itself with ``paddle_tpu.profiler`` so
+``profiler.serving_stats()`` aggregates every live engine in the process.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict
+
+__all__ = ["ServingMetrics"]
+
+# Latency distributions keep a bounded sliding window (a long-running
+# engine must not grow host memory with traffic); the cumulative totals
+# live in the counters.
+_LATENCY_WINDOW = 4096
+
+
+def _dist(xs) -> Dict[str, float]:
+    if not xs:
+        return {"count": 0, "mean": 0.0, "p50": 0.0, "p99": 0.0, "max": 0.0}
+    s = sorted(xs)
+    n = len(s)
+
+    def q(p):
+        return s[min(n - 1, int(p * (n - 1) + 0.5))]
+
+    return {"count": n, "mean": sum(s) / n, "p50": q(0.5), "p99": q(0.99),
+            "max": s[-1]}
+
+
+class ServingMetrics:
+    """Mutable metric sink for one ``serving.Engine``."""
+
+    def __init__(self, name: str = "engine", num_slots: int = 1):
+        self.name = name
+        self.num_slots = num_slots
+        self.t_start = time.perf_counter()
+        # counters
+        self.requests_enqueued = 0
+        self.requests_admitted = 0
+        self.requests_completed = 0
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        self.decode_steps = 0
+        self.compile_hits = 0
+        self.compile_misses = 0
+        self.prefills_by_bucket: Dict[int, int] = {}
+        # gauges / distributions
+        self.queue_depth = 0
+        self.queue_depth_max = 0
+        self.ttft_s: deque = deque(maxlen=_LATENCY_WINDOW)
+        self.itl_s: deque = deque(maxlen=_LATENCY_WINDOW)
+        self.decode_time_s = 0.0
+        self.prefill_time_s = 0.0
+        self._occupancy_sum = 0.0
+        self._occupancy_samples = 0
+        self._slots_busy = 0
+        from .. import profiler as _profiler
+
+        _profiler._register_serving_metrics(self)
+
+    # -- recording hooks ---------------------------------------------------
+
+    def on_enqueue(self, depth: int) -> None:
+        self.requests_enqueued += 1
+        self.queue_depth = depth
+        self.queue_depth_max = max(self.queue_depth_max, depth)
+
+    def on_admit(self, bucket: int, prompt_len: int, depth: int) -> None:
+        self.requests_admitted += 1
+        self.prefill_tokens += prompt_len
+        self.prefills_by_bucket[bucket] = \
+            self.prefills_by_bucket.get(bucket, 0) + 1
+        self.queue_depth = depth
+
+    def on_first_token(self, ttft_s: float) -> None:
+        self.ttft_s.append(ttft_s)
+
+    def on_decode_step(self, n_active: int, step_s: float) -> None:
+        self.decode_steps += 1
+        self.decode_tokens += n_active
+        self.decode_time_s += step_s
+        # per-token latency for each active stream is the step latency
+        self.itl_s.extend([step_s] * n_active)
+
+    def on_complete(self) -> None:
+        self.requests_completed += 1
+
+    def on_slots(self, busy: int) -> None:
+        self._slots_busy = busy
+        self._occupancy_sum += busy / max(self.num_slots, 1)
+        self._occupancy_samples += 1
+
+    def on_compile(self, miss: bool) -> None:
+        if miss:
+            self.compile_misses += 1
+        else:
+            self.compile_hits += 1
+
+    # -- export ------------------------------------------------------------
+
+    def tokens_per_sec(self) -> float:
+        return self.decode_tokens / self.decode_time_s \
+            if self.decode_time_s > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        """The ``/stats`` endpoint payload: one JSON-ready dict.  Latency
+        distributions cover the last ``_LATENCY_WINDOW`` samples."""
+        occ = self._occupancy_sum / self._occupancy_samples \
+            if self._occupancy_samples else 0.0
+        return {
+            "name": self.name,
+            "uptime_s": round(time.perf_counter() - self.t_start, 3),
+            "requests": {
+                "enqueued": self.requests_enqueued,
+                "admitted": self.requests_admitted,
+                "completed": self.requests_completed,
+                "running": self._slots_busy,
+            },
+            "queue_depth": self.queue_depth,
+            "queue_depth_max": self.queue_depth_max,
+            "slot_occupancy": round(occ, 4),
+            "slots": {"total": self.num_slots, "busy": self._slots_busy},
+            "tokens": {"prefill": self.prefill_tokens,
+                       "decode": self.decode_tokens},
+            "decode_tokens_per_sec": round(self.tokens_per_sec(), 2),
+            "ttft_ms": {k: round(v * 1e3, 3) if k != "count" else v
+                        for k, v in _dist(self.ttft_s).items()},
+            "inter_token_ms": {k: round(v * 1e3, 3) if k != "count" else v
+                               for k, v in _dist(self.itl_s).items()},
+            "prefills_by_bucket": dict(sorted(
+                self.prefills_by_bucket.items())),
+            "compile_cache": {"hits": self.compile_hits,
+                              "misses": self.compile_misses},
+        }

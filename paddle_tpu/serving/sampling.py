@@ -1,0 +1,52 @@
+"""Token sampling for the serving engine.
+
+Sampling runs host-side on the final-token logits (which cross to the host
+anyway for streaming callbacks and stop conditions), keeping the compiled
+decode step deterministic and RNG-state-free — one executable serves greedy
+and every temperature at once.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["SamplingParams", "sample"]
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decoding strategy.
+
+    ``temperature == 0`` → greedy argmax.  ``top_k > 0`` restricts sampling
+    to the k highest-probability tokens.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+
+def sample(logits: np.ndarray, params: SamplingParams,
+           rng: Optional[np.random.RandomState] = None) -> int:
+    """Pick the next token id from a ``[vocab]`` logits row."""
+    logits = np.asarray(logits, dtype=np.float64).reshape(-1)
+    if params.temperature == 0.0:
+        return int(np.argmax(logits))
+    z = logits / params.temperature
+    if params.top_k:
+        k = min(params.top_k, z.shape[0])
+        kth = np.partition(z, -k)[-k]
+        z = np.where(z >= kth, z, -np.inf)
+    z = z - z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    rng = rng or np.random
+    return int(rng.choice(p.shape[0], p=p))

@@ -26,6 +26,21 @@ def test_smoke_mode_emits_json_line():
     assert "vs_baseline" in out
 
 
+@pytest.mark.slow
+def test_serving_mode_emits_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_BENCH_MODE"] = "serving"
+    r = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serving_gpt_tiny_decode_tokens_per_sec"
+    assert out["value"] > 0
+    assert out["ttft_ms"] > 0
+    assert out["compile_misses"] > 0  # warmup compiles; steady state adds 0
+
+
 def test_preflight_failure_is_structured():
     """Force the probe to fail fast: preflight must print the structured
     error JSON and exit nonzero, never a bare traceback."""

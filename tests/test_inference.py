@@ -57,6 +57,64 @@ class TestPredictor:
 
 
 
+class TestPredictorPoolSharing:
+    def test_pool_loads_artifact_once(self, saved_model, monkeypatch):
+        """The pool's docstring promise: one jit_mod.load for N slots."""
+        import paddle_tpu.jit as jit_mod
+
+        path, x, want = saved_model
+        calls = []
+        orig = jit_mod.load
+
+        def counting(p, **k):
+            calls.append(p)
+            return orig(p, **k)
+
+        monkeypatch.setattr(jit_mod, "load", counting)
+        pool = inference.PredictorPool(inference.Config(path), 3)
+        assert len(calls) == 1
+        assert pool.retrieve(0)._layer is pool.retrieve(2)._layer
+        for i in range(3):
+            np.testing.assert_allclose(pool.retrieve(i).run([x])[0], want,
+                                       atol=1e-6)
+
+
+class TestPredictorInputNames:
+    def test_named_inputs_from_signature(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 4))
+        path = str(tmp_path / "named")
+        paddle.jit.save(model, path, input_spec=[
+            paddle.jit.InputSpec([2, 4], "float32", name="features")])
+        pred = inference.create_predictor(inference.Config(path))
+        assert pred.get_input_names() == ["features"]
+        h = pred.get_input_handle("features")
+        h.copy_from_cpu(np.zeros((2, 4), np.float32))
+        assert pred.run() is True
+
+    def test_unknown_input_keyerror_lists_names(self, saved_model):
+        path, _, _ = saved_model
+        pred = inference.create_predictor(inference.Config(path))
+        with pytest.raises(KeyError) as ei:
+            pred.get_input_handle("nope")
+        msg = str(ei.value)
+        assert "nope" in msg and "x0" in msg
+
+    def test_legacy_artifact_without_sidecar(self, tmp_path):
+        """Artifacts saved before the signature sidecar still serve with
+        synthesized names."""
+        import os
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 4))
+        path = str(tmp_path / "legacy")
+        paddle.jit.save(model, path, input_spec=[
+            paddle.jit.InputSpec([2, 4], "float32", name="features")])
+        os.remove(path + ".pdmeta.json")
+        pred = inference.create_predictor(inference.Config(path))
+        assert pred.get_input_names() == ["x0"]
+
+
 class TestModelScaleServingRoundtrip:
     """save -> load -> serve a REAL model (GPT causal-LM) through the
     Predictor, in f32 and bf16 (VERDICT r3: the predictor needs a

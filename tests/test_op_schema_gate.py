@@ -102,6 +102,9 @@ JUSTIFIED = {
     "margin_cross_entropy": _COMPOSITE, "hsigmoid_loss": _COMPOSITE,
     "gather_tree": _COMPOSITE, "sparse_attention": _COMPOSITE,
     "scaled_dot_product_attention": _COMPOSITE,
+    "cached_attention": (
+        "serving decode kernel over KV-cache state; parity vs the full-"
+        "recompute forward is asserted end-to-end in tests/test_serving.py"),
     "fused_linear_cross_entropy": (
         "enrolled as fused_linear_ce (labels need int sampling)"),
     "max_unpool1d": _COMPOSITE, "max_unpool2d": _COMPOSITE,
