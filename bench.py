@@ -219,6 +219,7 @@ def serving_main():
         fail_structured(
             f"steady-state recompile detected: {st['compile_cache']}",
             metric="serving_gpt_tiny_decode_tokens_per_sec")
+    fl = st["failures"]
     print(json.dumps({
         "metric": "serving_gpt_tiny_decode_tokens_per_sec",
         "value": st["decode_tokens_per_sec"],
@@ -230,6 +231,14 @@ def serving_main():
         "requests_completed": st["requests"]["completed"],
         "slot_occupancy": st["slot_occupancy"],
         "compile_misses": st["compile_cache"]["misses"],
+        # resilience counters (ISSUE 4): all zero on the smoke path —
+        # any nonzero value here flags a failure/retry during the bench
+        "requests_failed": fl["failed"],
+        "requests_cancelled": fl["cancelled"],
+        "requests_rejected": fl["rejected"],
+        "deadline_expired": fl["deadline_expired"],
+        "step_retries": fl["step_retries"],
+        "engine_state": st["health"]["state"],
     }))
 
 

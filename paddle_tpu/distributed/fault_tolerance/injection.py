@@ -22,6 +22,22 @@ Every fault fires at most once per process so a resumed run sails past
 the step that killed its predecessor (the predecessor's env is not
 inherited unless the harness re-sets it — but guard anyway: the chaos
 tests re-launch with the fault env cleared).
+
+Serving fault points (``ServingFaultPlan``) extend the same env-driven
+deterministic-trigger discipline to the serving engine: a fault is keyed
+to the Nth call of a named engine fault point (``serving.prefill``,
+``serving.decode``, ``serving.stream_cb``) instead of a training step,
+and either raises :class:`InjectedFault` (exercising retry / per-request
+error isolation) or stalls (exercising the step watchdog):
+
+- ``PADDLE_TPU_FT_SERVING_FAULTS="serving.decode@2"`` — raise at the 2nd
+  decode-step call;
+- ``"serving.prefill@1x2"`` — raise at prefill calls 1 and 2 (defeats a
+  single retry);
+- ``"serving.decode@3:stall=1.5"`` — sleep 1.5 s inside the 3rd decode
+  call (the watchdog window);
+- specs are comma-separated and each fires exactly over its declared
+  call window, so an injected run is reproducible call-for-call.
 """
 from __future__ import annotations
 
@@ -30,12 +46,18 @@ import signal
 import time
 from typing import Optional
 
-__all__ = ["FaultPlan", "corrupt_shard"]
+__all__ = ["FaultPlan", "ServingFaultPlan", "InjectedFault",
+           "corrupt_shard", "SERVING_FAULT_POINTS"]
 
 ENV_DIE_AT_STEP = "PADDLE_TPU_FT_DIE_AT_STEP"
 ENV_DIE_SIGNAL = "PADDLE_TPU_FT_DIE_SIGNAL"
 ENV_STALL_AT_STEP = "PADDLE_TPU_FT_STALL_AT_STEP"
 ENV_STALL_SECONDS = "PADDLE_TPU_FT_STALL_SECONDS"
+ENV_SERVING_FAULTS = "PADDLE_TPU_FT_SERVING_FAULTS"
+
+#: Fault points the serving engine checks (engine.py _step_call/_emit).
+SERVING_FAULT_POINTS = ("serving.prefill", "serving.decode",
+                        "serving.stream_cb")
 
 
 def _parse_signal(spec: str) -> int:
@@ -83,6 +105,86 @@ class FaultPlan:
         if self.die_at_step == step and not self._fired_die:
             self._fired_die = True
             os.kill(os.getpid(), self.die_signal)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a :class:`ServingFaultPlan` rule at its trigger call."""
+
+
+class ServingFaultPlan:
+    """Call-count-keyed faults for the serving engine's fault points.
+
+    Rules are deterministic: the engine calls :meth:`check` at every pass
+    through a fault point, the plan counts calls per point, and a rule
+    fires over the call window ``[at_call, at_call + times)`` — raising
+    :class:`InjectedFault` (default) or sleeping ``stall_s`` seconds (a
+    simulated wedged XLA call, for watchdog tests).  ``times > 1`` defeats
+    the engine's bounded retry.  Like the training faults, plans normally
+    come from env (``PADDLE_TPU_FT_SERVING_FAULTS``) so the production
+    serving loop IS the chaos workload; ``add()`` builds one in-process.
+    """
+
+    def __init__(self):
+        self._rules: list = []
+        self._calls: dict = {}
+
+    def add(self, point: str, at_call: int, times: int = 1,
+            stall_s: Optional[float] = None) -> "ServingFaultPlan":
+        if point not in SERVING_FAULT_POINTS:
+            raise ValueError(f"unknown serving fault point {point!r}; "
+                             f"want one of {SERVING_FAULT_POINTS}")
+        if at_call < 1 or times < 1:
+            raise ValueError("at_call and times must be >= 1")
+        self._rules.append({"point": point, "at": int(at_call),
+                            "times": int(times),
+                            "stall_s": None if stall_s is None
+                            else float(stall_s)})
+        return self
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "ServingFaultPlan":
+        """Parse ``point@N[xM][:stall=S]`` comma-separated specs."""
+        plan = cls()
+        raw = env.get(ENV_SERVING_FAULTS, "")
+        for spec in (s.strip() for s in raw.split(",")):
+            if not spec:
+                continue
+            point, sep, rest = spec.partition("@")
+            if not sep:
+                raise ValueError(f"bad serving fault spec {spec!r}: "
+                                 "expected point@N[xM][:stall=S]")
+            window, _, opt = rest.partition(":")
+            at, _, times = window.partition("x")
+            stall = None
+            if opt:
+                key, _, val = opt.partition("=")
+                if key != "stall":
+                    raise ValueError(f"bad serving fault option {opt!r} "
+                                     f"in {spec!r}: only 'stall=<s>'")
+                stall = float(val)
+            plan.add(point, int(at), int(times) if times else 1, stall)
+        return plan
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._rules)
+
+    def calls(self, point: str) -> int:
+        """How many times ``point`` has been checked so far."""
+        return self._calls.get(point, 0)
+
+    def check(self, point: str) -> None:
+        """Count one pass through ``point``; fire any matching rule."""
+        n = self._calls.get(point, 0) + 1
+        self._calls[point] = n
+        for r in self._rules:
+            if r["point"] != point or not \
+                    (r["at"] <= n < r["at"] + r["times"]):
+                continue
+            if r["stall_s"] is not None:
+                time.sleep(r["stall_s"])
+                return
+            raise InjectedFault(f"injected fault: {point} call #{n}")
 
 
 def corrupt_shard(ckpt_path: str, nth: int = 0, flip_at: float = 0.5) -> str:

@@ -90,6 +90,17 @@ class StepWatchdog:
         if self._thread.is_alive():
             self._thread.join(timeout=self.poll_interval * 4)
 
+    def request_stop(self):
+        """Signal the monitor thread to exit without joining — safe to
+        call from GC finalizers (join is not)."""
+        self._stop.set()
+
+    @property
+    def alive(self) -> bool:
+        """True while the monitor thread is still watching (it exits
+        after firing once when ``hard_exit`` is off, and on stop)."""
+        return self._thread.is_alive() and not self.fired
+
     # -- heartbeat -------------------------------------------------------
 
     def notify(self, step: int):

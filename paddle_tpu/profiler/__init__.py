@@ -48,20 +48,34 @@ def _register_serving_metrics(m) -> None:
     _serving_metrics.append(_weakref.ref(m))
 
 
-def serving_stats() -> dict:
-    """Snapshot of every live serving engine's metrics, keyed by engine
-    name (TTFT, inter-token latency, tokens/sec, queue depth, slot
-    occupancy, compile-cache hits/misses — see serving.ServingMetrics)."""
-    out = {}
-    live = []
+def _live_serving_metrics():
+    """Dereference the registry, pruning entries whose engine is gone."""
+    out, live = [], []
     for ref in _serving_metrics:
         m = ref()
         if m is None:
             continue
         live.append(ref)
-        out[m.name] = m.snapshot()
+        out.append(m)
     _serving_metrics[:] = live
     return out
+
+
+def serving_stats() -> dict:
+    """Snapshot of every live serving engine's metrics, keyed by engine
+    name (TTFT, inter-token latency, tokens/sec, queue depth, slot
+    occupancy, compile-cache hits/misses, failure/retry counters, and the
+    engine health snapshot — see serving.ServingMetrics)."""
+    return {m.name: m.snapshot() for m in _live_serving_metrics()}
+
+
+def serving_health() -> dict:
+    """Liveness-only view over every live engine, keyed by engine name:
+    state (active/draining/stopped/unhealthy), last-step age, consecutive
+    compiled-step failures, queue depth, free slots.  The cheap probe a
+    load balancer polls — no latency distributions are computed."""
+    return {m.name: m.health_cb() for m in _live_serving_metrics()
+            if m.health_cb is not None}
 
 
 class ProfilerState(enum.Enum):

@@ -9,15 +9,25 @@ families (``models.GPTForCausalLM`` / ``models.LlamaForCausalLM``):
   compiled-executable cache (zero steady-state recompiles), greedy /
   temperature sampling, per-token streaming callbacks;
 - :class:`ServingMetrics` — TTFT / inter-token latency / tokens-per-sec /
-  queue depth / slot occupancy / compile-cache counters, exported as a
-  ``/stats``-style dict and via ``paddle_tpu.profiler.serving_stats()``.
+  queue depth / slot occupancy / compile-cache / failure counters,
+  exported as a ``/stats``-style dict and via
+  ``paddle_tpu.profiler.serving_stats()``.
+
+The engine degrades per-request, never per-engine: terminal states
+``failed | cancelled | rejected`` with recorded errors, wall-clock
+deadlines, bounded-queue backpressure (:class:`QueueFull`), bounded step
+retry, watchdog-backed hang detection, and ``drain()`` / ``shutdown()`` /
+``health()`` lifecycle — see docs/SERVING.md "Failure semantics".
 
 See ``docs/SERVING.md`` for the architecture and an end-to-end example.
 """
 from .kv_cache import KVCache, CacheContext  # noqa: F401
 from .sampling import SamplingParams, sample  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
-from .engine import Engine, Request  # noqa: F401
+from .engine import (  # noqa: F401
+    Engine, Request, QueueFull, EngineStopped,
+)
 
 __all__ = ["KVCache", "CacheContext", "Engine", "Request",
-           "SamplingParams", "ServingMetrics", "sample"]
+           "SamplingParams", "ServingMetrics", "sample",
+           "QueueFull", "EngineStopped"]

@@ -19,11 +19,25 @@ they finish (eos / token budget / cache capacity), in the spirit of
 fine-grained compute/host-scheduling overlap (T3, arXiv:2401.16677) —
 host-side sampling and scheduling happen while the next step's arguments
 are assembled.
+
+Resilience (docs/SERVING.md "Failure semantics"): the scheduler degrades
+per-request, never per-engine.  Requests own terminal states
+``finished | failed | cancelled | rejected`` plus an ``error`` record;
+every exit path funnels through ``_retire`` so a slot (and its cache
+length) can never leak.  A raising ``stream_cb`` or sampling failure fails
+only its request; a failed compiled step retries once with backoff before
+failing only the implicated requests.  Admission is bounded
+(``max_queue`` + reject/block policy), deadlines are wall-clock and
+enforced in ``step()``, and ``drain()``/``shutdown()``/``health()`` give
+the engine an explicit lifecycle.  None of this changes any compiled
+shape: deadlines, cancellation, and retirement only alter argument
+values, so the zero-recompile steady state survives every failure path.
 """
 from __future__ import annotations
 
 import itertools
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
@@ -37,14 +51,39 @@ from .kv_cache import KVCache, CacheContext
 from .metrics import ServingMetrics
 from .sampling import SamplingParams, sample
 
-__all__ = ["Engine", "Request", "SamplingParams"]
+__all__ = ["Engine", "Request", "SamplingParams", "QueueFull",
+           "EngineStopped"]
 
 _engine_counter = itertools.count()
+
+#: Request states a request can never leave.
+TERMINAL_STATES = frozenset({"finished", "failed", "cancelled", "rejected"})
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected by backpressure: the request queue is at
+    ``max_queue`` (and, under the ``block`` policy, stayed full past the
+    block timeout).  Carries the observed ``depth``."""
+
+    def __init__(self, msg: str, depth: int):
+        super().__init__(msg)
+        self.depth = depth
+
+
+class EngineStopped(RuntimeError):
+    """``add_request`` after ``drain()``/``shutdown()`` (or on an
+    unhealthy engine): the engine no longer admits work."""
 
 
 @dataclass(eq=False)           # a live handle: identity, not field equality
 class Request:
-    """One generation request moving through the engine."""
+    """One generation request moving through the engine.
+
+    State machine: ``queued → running → finished | failed | cancelled``;
+    malformed or backpressured requests go straight to ``rejected`` at
+    enqueue time and are never admitted.  ``error`` records why a request
+    ended ``failed``/``rejected``.
+    """
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 16
@@ -52,9 +91,11 @@ class Request:
     eos_token_id: Optional[int] = None
     stream_cb: Optional[Callable[[int, "Request"], None]] = None
     request_id: int = -1
+    deadline_s: Optional[float] = None   # wall-clock budget from enqueue
 
     # lifecycle (engine-managed)
-    state: str = "queued"            # queued | running | finished
+    state: str = "queued"
+    error: Optional[str] = None
     slot: Optional[int] = None
     output_ids: List[int] = field(default_factory=list)
     prefill_bucket: int = 0
@@ -63,10 +104,16 @@ class Request:
     t_finish: Optional[float] = None
     _rng: Optional[np.random.RandomState] = None
     _seq_len: int = 0                # prompt + emitted tokens in the cache
+    _cancel: bool = False
+    _engine: Optional[object] = field(default=None, repr=False)
 
     @property
     def finished(self) -> bool:
         return self.state == "finished"
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -74,12 +121,20 @@ class Request:
             return None
         return self.t_first_token - self.t_enqueue
 
-    def _emit(self, token: int, now: float) -> None:
-        if self.t_first_token is None:
-            self.t_first_token = now
-        self.output_ids.append(int(token))
-        if self.stream_cb is not None:
-            self.stream_cb(int(token), self)
+    def cancel(self) -> bool:
+        """Ask the engine to stop this request.  Honored immediately while
+        queued; a running request is retired ``cancelled`` at the next
+        step boundary (before its next decode).  Returns False if the
+        request is already terminal."""
+        if self.done:
+            return False
+        self._cancel = True
+        eng = self._engine() if self._engine is not None else None
+        if eng is not None:
+            eng._on_cancel(self)
+        elif self.state == "queued":
+            self.state = "cancelled"
+        return True
 
 
 class Engine:
@@ -94,11 +149,39 @@ class Engine:
         min_bucket: smallest prefill bucket; buckets are powers of two up
             to ``max_seq``.
         cache_dtype: KV cache dtype (default: the model's param dtype).
+        max_queue: bound on queued (not-yet-admitted) requests; ``None``
+            (default) is unbounded.
+        queue_policy: what a full queue does to ``add_request``:
+            ``"reject"`` raises :class:`QueueFull` immediately; ``"block"``
+            drives ``step()`` until space frees or ``block_timeout_s``
+            elapses (then raises :class:`QueueFull`).
+        block_timeout_s: default wait budget for the ``block`` policy.
+        default_deadline_s: wall-clock deadline applied to requests that
+            set none themselves (``None`` = no deadline).
+        max_step_retries: how many times a failed compiled prefill/decode
+            call is retried (with exponential backoff) before the
+            implicated requests are failed.  Safe because compiled-state
+            writeback happens only after a step returns successfully.
+        retry_backoff_s: base backoff before the first retry.
+        step_timeout_s: arm a ``StepWatchdog`` around every compiled step;
+            a call exceeding the deadline dumps all thread stacks and
+            flips the engine to the ``unhealthy`` state (visible via
+            ``health()``) instead of wedging silently.
+        fault_plan: a ``ServingFaultPlan`` for chaos testing; defaults to
+            the env-armed plan (``PADDLE_TPU_FT_SERVING_FAULTS``).
     """
 
     def __init__(self, model, *, num_slots: int = 4,
                  max_seq: Optional[int] = None, min_bucket: int = 8,
-                 cache_dtype=None, name: Optional[str] = None):
+                 cache_dtype=None, name: Optional[str] = None,
+                 max_queue: Optional[int] = None,
+                 queue_policy: str = "reject",
+                 block_timeout_s: float = 30.0,
+                 default_deadline_s: Optional[float] = None,
+                 max_step_retries: int = 1,
+                 retry_backoff_s: float = 0.05,
+                 step_timeout_s: Optional[float] = None,
+                 fault_plan=None):
         cfg = getattr(model, "config", None)
         if cfg is None:
             raise TypeError("Engine needs a model carrying a .config "
@@ -119,6 +202,15 @@ class Engine:
         self.min_bucket = int(min_bucket)
         if self.min_bucket < 1:
             raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+        if queue_policy not in ("reject", "block"):
+            raise ValueError(f"queue_policy must be 'reject' or 'block', "
+                             f"got {queue_policy!r}")
+        if max_queue is not None and int(max_queue) < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_step_retries < 0:
+            raise ValueError("max_step_retries must be >= 0")
+        if step_timeout_s is not None and step_timeout_s <= 0:
+            raise ValueError("step_timeout_s must be > 0")
         self.buckets = self._make_buckets()
         kv_heads = getattr(cfg, "n_kv_heads", None) or cfg.num_attention_heads
         if cache_dtype is None:
@@ -130,6 +222,7 @@ class Engine:
             head_dim=cfg.head_dim, dtype=cache_dtype)
         self.name = name or f"engine-{next(_engine_counter)}"
         self.metrics = ServingMetrics(self.name, num_slots=self.num_slots)
+        self.metrics.health_cb = self.health
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}
         self.free_slots: List[int] = list(range(self.num_slots))
@@ -137,6 +230,27 @@ class Engine:
         self._req_counter = itertools.count()
         self._prefill_fn = None
         self._decode_fn = None
+        # resilience / lifecycle
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.queue_policy = queue_policy
+        self.block_timeout_s = float(block_timeout_s)
+        self.default_deadline_s = default_deadline_s
+        self.max_step_retries = int(max_step_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.step_timeout_s = step_timeout_s
+        if fault_plan is None:
+            from ..distributed.fault_tolerance.injection import \
+                ServingFaultPlan
+
+            fault_plan = ServingFaultPlan.from_env()
+        self.fault_plan = fault_plan
+        self.state = "active"    # active | draining | stopped | unhealthy
+        self._unhealthy_reason: Optional[str] = None
+        self._consecutive_failures = 0
+        self._step_counter = 0
+        self._last_step_t: Optional[float] = None
+        self._watchdog = None
+        self._arm_counter = 0
 
     # -- compiled steps ----------------------------------------------------
 
@@ -196,6 +310,75 @@ class Engine:
         self.metrics.on_compile(miss=len(fn.program_cache) > before)
         return out
 
+    # -- resilience plumbing -----------------------------------------------
+
+    def _fault(self, point: str) -> None:
+        if self.fault_plan is not None and self.fault_plan.armed:
+            self.fault_plan.check(point)
+
+    def _mark_wedged(self) -> None:
+        # runs on the watchdog thread; the stalled call may still return
+        # later, but the engine is permanently visible as unhealthy
+        self._unhealthy_reason = (
+            f"step watchdog fired: no step completion within "
+            f"{self.step_timeout_s}s (stacks dumped to stderr)")
+        self.state = "unhealthy"
+
+    def _arm_watchdog(self) -> None:
+        if self.step_timeout_s is None:
+            return
+        if self._watchdog is None:
+            from ..distributed.fault_tolerance.watchdog import StepWatchdog
+
+            # the watchdog thread must not pin the engine (model + KV
+            # cache): route on_timeout through a weakref, and let the
+            # thread exit on its own if the engine is GC'd without
+            # drain()/shutdown() (Event.set is safe in a finalizer;
+            # joining is not)
+            wref = weakref.ref(self)
+
+            def _on_timeout():
+                eng = wref()
+                if eng is not None:
+                    eng._mark_wedged()
+
+            self._watchdog = StepWatchdog(
+                self.step_timeout_s, hard_exit=False,
+                on_timeout=_on_timeout)
+            self._watchdog.start()
+            weakref.finalize(self, self._watchdog.request_stop)
+        self._arm_counter += 1
+        self._watchdog.notify(self._arm_counter)
+
+    def _disarm_watchdog(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.pause()
+
+    def _step_call(self, point: str, fn, *args):
+        """One compiled step with watchdog arming, fault injection, and a
+        bounded retry.  Retry is state-safe: ``jit`` writes cache state
+        back only after a call returns, so a failed attempt left the KV
+        cache and lengths untouched."""
+        last_err = None
+        for attempt in range(self.max_step_retries + 1):
+            if attempt:
+                self.metrics.on_retry(point)
+                time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+            try:
+                self._arm_watchdog()
+                try:
+                    self._fault(point)
+                    out = self._call_counted(fn, *args)
+                finally:
+                    self._disarm_watchdog()
+                self._consecutive_failures = 0
+                return out
+            except Exception as e:       # noqa: BLE001 — isolated upstream
+                last_err = e
+                self._consecutive_failures += 1
+                self.metrics.on_step_failure(point)
+        raise last_err
+
     # -- public API --------------------------------------------------------
 
     @classmethod
@@ -232,33 +415,83 @@ class Engine:
             "model Layer.  (jit.save artifacts have no cache-aware forward;"
             " serve those through inference.Predictor instead.)")
 
+    def _validate(self, req: Request) -> Optional[str]:
+        """Enqueue-time validation: a malformed request is ``rejected``
+        here, never admitted (where a failure would waste a prefill)."""
+        if req.prompt_ids.size == 0:
+            return "empty prompt"
+        if req.prompt_ids.size > self.max_seq:
+            return (f"prompt length {req.prompt_ids.size} exceeds "
+                    f"max_seq={self.max_seq}")
+        if req.max_new_tokens < 1:
+            return f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            return f"deadline_s must be > 0, got {req.deadline_s}"
+        return None
+
+    def _reject(self, req: Request, reason: str) -> None:
+        req.state, req.error = "rejected", reason
+        req.t_finish = time.perf_counter()
+        self.metrics.on_reject()
+
     def add_request(self, prompt_ids: Sequence[int], *,
                     max_new_tokens: int = 16,
                     sampling: Optional[SamplingParams] = None,
                     temperature: Optional[float] = None,
                     eos_token_id: Optional[int] = None,
-                    stream_cb: Optional[Callable] = None) -> Request:
+                    stream_cb: Optional[Callable] = None,
+                    deadline_s: Optional[float] = None,
+                    block_timeout_s: Optional[float] = None) -> Request:
         """Enqueue a prompt; it is admitted into a slot by a later
-        ``step()``.  Returns the live Request handle."""
+        ``step()``.  Returns the live Request handle.
+
+        Malformed requests are marked ``rejected`` and raise ``ValueError``
+        (the rejected handle rides on the exception's ``.request``).  A
+        full queue raises :class:`QueueFull` under the ``reject`` policy,
+        or blocks (driving ``step()``) up to ``block_timeout_s`` under
+        ``block``.  ``deadline_s`` is this request's wall-clock budget
+        from enqueue (default: the engine's ``default_deadline_s``)."""
+        if self.state != "active":
+            raise EngineStopped(
+                f"engine {self.name!r} is {self.state}: not admitting "
+                "new requests")
         prompt = np.asarray(list(prompt_ids), dtype=np.int64).reshape(-1)
-        if prompt.size == 0:
-            raise ValueError("empty prompt")
-        if prompt.size > self.max_seq:
-            raise ValueError(f"prompt length {prompt.size} exceeds "
-                             f"max_seq={self.max_seq}")
-        if int(max_new_tokens) < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if sampling is None:
             sampling = SamplingParams(temperature=temperature or 0.0)
         req = Request(prompt_ids=prompt, max_new_tokens=int(max_new_tokens),
                       sampling=sampling, eos_token_id=eos_token_id,
                       stream_cb=stream_cb,
+                      deadline_s=(deadline_s if deadline_s is not None
+                                  else self.default_deadline_s),
                       request_id=next(self._req_counter))
         req.t_enqueue = time.perf_counter()
+        problem = self._validate(req)
+        if problem is not None:
+            self._reject(req, problem)
+            err = ValueError(problem)
+            err.request = req
+            raise err
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            if self.queue_policy == "block":
+                budget = self.block_timeout_s if block_timeout_s is None \
+                    else float(block_timeout_s)
+                t_end = time.perf_counter() + budget
+                while len(self.queue) >= self.max_queue:
+                    if time.perf_counter() >= t_end:
+                        break
+                    self.step()          # drain: admit/decode in-flight work
+            if len(self.queue) >= self.max_queue:
+                depth = len(self.queue)
+                msg = (f"queue full: {depth} >= max_queue={self.max_queue} "
+                       f"(policy={self.queue_policy})")
+                self._reject(req, msg)
+                err = QueueFull(msg, depth)
+                err.request = req
+                raise err
         req._rng = np.random.RandomState(
             sampling.seed if sampling.seed is not None
             else (req.request_id + 1) * 7919)
+        req._engine = weakref.ref(self)
         self.queue.append(req)
         self.metrics.on_enqueue(len(self.queue))
         return req
@@ -271,6 +504,8 @@ class Engine:
             raise RuntimeError("warmup() must run before serving traffic "
                                "(it scribbles over slot 0 and resets all "
                                "slot lengths)")
+        if self.state != "active":
+            raise EngineStopped(f"engine {self.name!r} is {self.state}")
         if self._prefill_fn is None:
             self._build_steps()
         for b in (buckets or self.buckets):
@@ -287,26 +522,114 @@ class Engine:
 
     # -- scheduling --------------------------------------------------------
 
-    def _admit(self, req: Request, slot: int) -> None:
+    def _deadline_expired(self, req: Request, now: float) -> bool:
+        return req.deadline_s is not None and \
+            (now - req.t_enqueue) > req.deadline_s
+
+    def _fail_deadline(self, req: Request) -> None:
+        self.metrics.on_deadline()
+        self._retire(req, "failed",
+                     error=f"deadline of {req.deadline_s}s exceeded")
+
+    def _on_cancel(self, req: Request) -> None:
+        """Queued requests leave immediately; running ones are retired at
+        the next step boundary (their slot's cache state is untouched
+        mid-step — retirement only changes argument values)."""
+        if req.state != "queued":
+            return
+        try:
+            self.queue.remove(req)
+        except ValueError:
+            # already claimed by the scheduler (popped for admission, or
+            # reaped): leave the flag — _admit/_reap honor it.  Retiring
+            # here would free a slot the scheduler just assigned.
+            return
+        self._retire(req, "cancelled")
+        self.metrics.queue_depth = len(self.queue)
+
+    def _reap(self, now: float) -> None:
+        """Honor cancellations and deadlines before building this step's
+        batches, for queued and running requests alike."""
+        for req in list(self.queue):
+            if not (req.done or req._cancel or
+                    self._deadline_expired(req, now)):
+                continue
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                continue     # a concurrent cancel() already removed it
+            if req.done:
+                continue
+            if req._cancel:
+                self._retire(req, "cancelled")
+            else:
+                self._fail_deadline(req)
+        for req in list(self.running.values()):
+            if req._cancel:
+                self._retire(req, "cancelled")
+            elif self._deadline_expired(req, now):
+                self._fail_deadline(req)
+        self.metrics.queue_depth = len(self.queue)
+
+    def _emit_token(self, req: Request, tok: int, now: float) -> bool:
+        """Record one emitted token and run the stream callback.  A
+        raising callback fails THIS request only: the error is recorded on
+        the request and counted, never propagated into the batch."""
+        if req.t_first_token is None:
+            req.t_first_token = now
+        req.output_ids.append(int(tok))
+        if req.stream_cb is not None:
+            try:
+                self._fault("serving.stream_cb")
+                req.stream_cb(int(tok), req)
+            except Exception as e:       # noqa: BLE001 — isolation boundary
+                self.metrics.on_callback_error()
+                self._retire(req, "failed",
+                             error=f"stream_cb raised: "
+                                   f"{type(e).__name__}: {e}")
+                return False
+        return True
+
+    def _admit(self, req: Request) -> None:
+        """Prefill ``req`` into its pre-assigned slot.  Never raises for
+        request-level problems — a prefill/sampling/callback failure fails
+        this request only (``_retire`` reclaims the slot)."""
+        if req._cancel:                  # cancelled between pop and prefill
+            self._retire(req, "cancelled")
+            return
         L = int(req.prompt_ids.size)
         bucket = self.bucket_for(L)
         ids = np.zeros((1, bucket), dtype=np.int64)
         ids[0, :L] = req.prompt_ids
         t0 = time.perf_counter()
-        last = self._call_counted(
-            self._prefill_fn, to_tensor(ids),
-            to_tensor(np.int32(slot)), to_tensor(np.int32(L)))
+        try:
+            last = self._step_call(
+                "serving.prefill", self._prefill_fn, to_tensor(ids),
+                to_tensor(np.int32(req.slot)), to_tensor(np.int32(L)))
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            self._retire(req, "failed",
+                         error=f"prefill failed after "
+                               f"{self.max_step_retries} retr"
+                               f"{'y' if self.max_step_retries == 1 else 'ies'}"
+                               f": {type(e).__name__}: {e}")
+            return
         logits = last.numpy()
         now = time.perf_counter()
         self.metrics.prefill_time_s += now - t0
-        req.state, req.slot, req.prefill_bucket = "running", slot, bucket
+        req.state, req.prefill_bucket = "running", bucket
         req._seq_len = L
+        self.running[req.slot] = req
         self.metrics.on_admit(bucket, L, len(self.queue))
-        tok = sample(logits, req.sampling, req._rng)
-        req._emit(tok, now)
+        try:
+            tok = sample(logits, req.sampling, req._rng)
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            self._retire(req, "failed",
+                         error=f"sampling failed: {type(e).__name__}: {e}")
+            return
+        self._last_token[req.slot] = tok
+        if not self._emit_token(req, tok, now):
+            return
         self.metrics.on_first_token(req.ttft_s)
-        self.running[slot] = req
-        self._last_token[slot] = tok
         if self._done_after_emit(req):
             self._retire(req)
 
@@ -322,13 +645,28 @@ class Engine:
             return True
         return False
 
-    def _retire(self, req: Request) -> None:
-        slot = req.slot
-        req.state = "finished"
+    def _retire(self, req: Request, state: str = "finished",
+                error: Optional[str] = None) -> None:
+        """THE single exit path: every terminal transition funnels here,
+        so the slot is reclaimed exactly once on every outcome.
+        Idempotent — a request already terminal is left untouched."""
+        if req.done:
+            return
+        req.state = state
+        if error is not None:
+            req.error = error
         req.t_finish = time.perf_counter()
-        self.running.pop(slot, None)
-        self.free_slots.append(slot)
-        self.metrics.on_complete()
+        slot = req.slot
+        if slot is not None:
+            self.running.pop(slot, None)
+            if slot not in self.free_slots:
+                self.free_slots.append(slot)
+        if state == "finished":
+            self.metrics.on_complete()
+        elif state == "cancelled":
+            self.metrics.on_cancel()
+        elif state == "failed":
+            self.metrics.on_fail()
 
     def _decode(self) -> None:
         toks = np.zeros((self.num_slots, 1), dtype=np.int64)
@@ -337,30 +675,72 @@ class Engine:
             toks[slot, 0] = self._last_token[slot]
             active[slot] = 1
         t0 = time.perf_counter()
-        out = self._call_counted(
-            self._decode_fn, to_tensor(toks), to_tensor(active))
+        try:
+            out = self._step_call("serving.decode", self._decode_fn,
+                                  to_tensor(toks), to_tensor(active))
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            # retry budget exhausted: every request in THIS batch is
+            # implicated; fail them (reclaiming their slots) and keep the
+            # engine alive for queued work
+            msg = (f"decode step failed after {self.max_step_retries} "
+                   f"retr{'y' if self.max_step_retries == 1 else 'ies'}: "
+                   f"{type(e).__name__}: {e}")
+            for req in list(self.running.values()):
+                self._retire(req, "failed", error=msg)
+            return
         logits = out.numpy()                     # [slots, V]
         now = time.perf_counter()
         self.metrics.on_decode_step(len(self.running), now - t0)
         for slot, req in list(self.running.items()):
             req._seq_len += 1                    # token written this step
-            tok = sample(logits[slot], req.sampling, req._rng)
-            req._emit(tok, now)
+            try:
+                tok = sample(logits[slot], req.sampling, req._rng)
+            except Exception as e:       # noqa: BLE001 — isolation boundary
+                self._retire(req, "failed",
+                             error=f"sampling failed: "
+                                   f"{type(e).__name__}: {e}")
+                continue
             self._last_token[slot] = tok
+            if not self._emit_token(req, tok, now):
+                continue
+            if req.done:                 # cancelled from inside its cb
+                continue
             if self._done_after_emit(req):
                 self._retire(req)
 
     def step(self) -> bool:
-        """One scheduler tick: admit queued requests into free slots, then
-        run one decode step for all running slots.  Returns True while
-        there is in-flight or queued work."""
+        """One scheduler tick: reap cancellations/deadlines, admit queued
+        requests into free slots, then run one decode step for all running
+        slots.  Returns True while there is in-flight or queued work.
+        Raises ``EngineStopped`` once the watchdog has marked the engine
+        unhealthy."""
+        if self.state == "unhealthy":
+            raise EngineStopped(
+                f"engine {self.name!r} is unhealthy: "
+                f"{self._unhealthy_reason}")
         if self._prefill_fn is None:
             self._build_steps()
+        self._reap(time.perf_counter())
         while self.free_slots and self.queue:
-            self._admit(self.queue.popleft(), self.free_slots.pop())
+            req = self.queue.popleft()
+            if req.done:                 # cancelled/expired while queued
+                continue
+            req.slot = self.free_slots.pop()
+            try:
+                self._admit(req)
+            except BaseException:
+                # _admit isolates request-level failures itself; this is
+                # the guarantee that even an engine-level bug (or
+                # KeyboardInterrupt mid-prefill) cannot leak the slot
+                if not req.done:
+                    self._retire(req, "failed",
+                                 error="admission aborted by engine error")
+                raise
         self.metrics.on_slots(len(self.running))
         if self.running:
             self._decode()
+        self._step_counter += 1
+        self._last_step_t = time.perf_counter()
         return bool(self.running or self.queue)
 
     def run(self, max_steps: Optional[int] = None) -> None:
@@ -380,6 +760,78 @@ class Engine:
                                  **request_kwargs) for p in prompts]
         self.run()
         return [r.output_ids for r in reqs]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, max_steps: Optional[int] = None) -> dict:
+        """Stop admitting new requests, finish all queued and in-flight
+        work, and return the final stats snapshot.  The engine ends in the
+        ``stopped`` state (``add_request`` raises ``EngineStopped``)."""
+        if self.state == "active":
+            self.state = "draining"
+        n = 0
+        while (self.running or self.queue) and self.state == "draining":
+            self.step()
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        if self.state == "draining" and not (self.running or self.queue):
+            self.state = "stopped"
+            self._stop_watchdog()
+        return self.stats()
+
+    def shutdown(self, timeout_s: Optional[float] = None) -> dict:
+        """Drain with a wall-clock budget, then cancel whatever work is
+        still unfinished and stop the engine.  ``timeout_s=None`` waits
+        for all work (equivalent to ``drain()`` + final cleanup)."""
+        if self.state == "active":
+            self.state = "draining"
+        deadline = None if timeout_s is None \
+            else time.perf_counter() + float(timeout_s)
+        while (self.running or self.queue) and self.state == "draining":
+            if deadline is not None and time.perf_counter() >= deadline:
+                break
+            self.step()
+        for req in list(self.queue) + list(self.running.values()):
+            self._retire(req, "cancelled", error="engine shutdown")
+        self.queue.clear()
+        self.metrics.queue_depth = 0
+        if self.state != "unhealthy":
+            self.state = "stopped"
+        self._stop_watchdog()
+        return self.stats()
+
+    def _stop_watchdog(self) -> None:
+        """Join and drop the watchdog thread so a drained/stopped engine
+        holds no thread alive (its bound-method callback would otherwise
+        pin the engine — model and KV cache included — forever)."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+    def health(self) -> dict:
+        """Liveness snapshot: engine state, last-step age, consecutive
+        compiled-step failures, and capacity gauges — the probe a load
+        balancer or the profiler surface polls."""
+        now = time.perf_counter()
+        return {
+            "state": self.state,
+            "reason": self._unhealthy_reason,
+            "steps": self._step_counter,
+            "last_step_age_s": None if self._last_step_t is None
+            else round(now - self._last_step_t, 3),
+            "consecutive_step_failures": self._consecutive_failures,
+            "queue_depth": len(self.queue),
+            "slots_free": len(self.free_slots),
+            "slots_total": self.num_slots,
+            # armed = hang detection is actually protecting future steps:
+            # configured, engine still stepping, monitor thread not yet
+            # fired/stopped (it is started lazily at the first step)
+            "watchdog_armed": bool(
+                self.step_timeout_s is not None
+                and self.state in ("active", "draining")
+                and (self._watchdog is None or self._watchdog.alive)),
+        }
 
     def stats(self) -> dict:
         """``/stats``-style snapshot (also exported through

@@ -2,8 +2,11 @@
 
 Counters and latency distributions a production deployment exports per
 engine: time-to-first-token (TTFT), inter-token latency (ITL), decode
-throughput, queue depth, slot occupancy, and the compile-executable cache
-hit/miss counters that back the zero-recompile steady-state guarantee.
+throughput, queue depth, slot occupancy, the compile-executable cache
+hit/miss counters that back the zero-recompile steady-state guarantee,
+and the failure-path counters of the resilience layer (failed/cancelled/
+rejected requests, deadline expiries, callback errors, step failures and
+retries) plus the engine's ``health()`` snapshot.
 
 ``snapshot()`` returns a ``/stats``-style plain dict (JSON-serializable).
 Each ``ServingMetrics`` registers itself with ``paddle_tpu.profiler`` so
@@ -47,6 +50,18 @@ class ServingMetrics:
         self.requests_enqueued = 0
         self.requests_admitted = 0
         self.requests_completed = 0
+        # failure-path counters (the resilience layer's observability:
+        # every rejection/cancellation/deadline/retry is visible here)
+        self.requests_failed = 0
+        self.requests_cancelled = 0
+        self.requests_rejected = 0
+        self.deadline_expired = 0
+        self.callback_errors = 0
+        self.step_failures = 0
+        self.step_retries = 0
+        self.retries_by_point: Dict[str, int] = {}
+        # engine-provided liveness snapshot (set by serving.Engine)
+        self.health_cb = None
         self.prefill_tokens = 0
         self.decode_tokens = 0
         self.decode_steps = 0
@@ -94,6 +109,29 @@ class ServingMetrics:
     def on_complete(self) -> None:
         self.requests_completed += 1
 
+    def on_fail(self) -> None:
+        self.requests_failed += 1
+
+    def on_cancel(self) -> None:
+        self.requests_cancelled += 1
+
+    def on_reject(self) -> None:
+        self.requests_rejected += 1
+
+    def on_deadline(self) -> None:
+        self.deadline_expired += 1
+
+    def on_callback_error(self) -> None:
+        self.callback_errors += 1
+
+    def on_step_failure(self, point: str) -> None:
+        self.step_failures += 1
+
+    def on_retry(self, point: str) -> None:
+        self.step_retries += 1
+        self.retries_by_point[point] = \
+            self.retries_by_point.get(point, 0) + 1
+
     def on_slots(self, busy: int) -> None:
         self._slots_busy = busy
         self._occupancy_sum += busy / max(self.num_slots, 1)
@@ -125,6 +163,19 @@ class ServingMetrics:
                 "completed": self.requests_completed,
                 "running": self._slots_busy,
             },
+            "failures": {
+                "failed": self.requests_failed,
+                "cancelled": self.requests_cancelled,
+                "rejected": self.requests_rejected,
+                "deadline_expired": self.deadline_expired,
+                "callback_errors": self.callback_errors,
+                "step_failures": self.step_failures,
+                "step_retries": self.step_retries,
+                "retries_by_point": dict(sorted(
+                    self.retries_by_point.items())),
+            },
+            "health": self.health_cb() if self.health_cb is not None
+            else None,
             "queue_depth": self.queue_depth,
             "queue_depth_max": self.queue_depth_max,
             "slot_occupancy": round(occ, 4),

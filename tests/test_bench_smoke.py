@@ -39,6 +39,11 @@ def test_serving_mode_emits_json_line():
     assert out["value"] > 0
     assert out["ttft_ms"] > 0
     assert out["compile_misses"] > 0  # warmup compiles; steady state adds 0
+    # resilience counters ride along and are all zero on the smoke path
+    for k in ("requests_failed", "requests_cancelled", "requests_rejected",
+              "deadline_expired", "step_retries"):
+        assert out[k] == 0, (k, out)
+    assert out["engine_state"] == "active"
 
 
 def test_preflight_failure_is_structured():
