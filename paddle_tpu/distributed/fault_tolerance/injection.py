@@ -55,9 +55,12 @@ ENV_STALL_AT_STEP = "PADDLE_TPU_FT_STALL_AT_STEP"
 ENV_STALL_SECONDS = "PADDLE_TPU_FT_STALL_SECONDS"
 ENV_SERVING_FAULTS = "PADDLE_TPU_FT_SERVING_FAULTS"
 
-#: Fault points the serving engine checks (engine.py _step_call/_emit).
+#: Fault points the serving engine checks (engine.py _step_call/_emit;
+#: ``serving.prefix_lookup`` fires inside the paged engine's host-side
+#: prefix-cache lookup — a raising/stalling lookup must degrade to a
+#: cache miss, never fail the request or leak a block).
 SERVING_FAULT_POINTS = ("serving.prefill", "serving.decode",
-                        "serving.stream_cb")
+                        "serving.stream_cb", "serving.prefix_lookup")
 
 
 def _parse_signal(spec: str) -> int:

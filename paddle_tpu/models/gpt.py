@@ -126,10 +126,12 @@ class GPTAttention(Layer):
                 q, k, v, dropout_p=self.dropout_p, is_causal=True,
                 training=self.training)
         elif cache_ctx.mode == "prefill":
-            # prompt forward is ordinary causal attention; K/V land in the
-            # cache so decode can extend the sequence one token at a time
+            # prompt forward writes K/V into the cache; attention routes
+            # through the context — ordinary causal for the contiguous
+            # layout, gather-by-block-table with a cached-prefix mask for
+            # the paged layout (the tail bucket attends onto shared blocks)
             cache_ctx.write_prefill(k, v)
-            ctx = _flash_attention(q, k, v, is_causal=True, training=False)
+            ctx = cache_ctx.prefill_attention(q, k, v)
         else:                                   # decode: S == 1 per slot
             k_full, v_full, lens = cache_ctx.write_decode(k, v)
             ctx = _cached_attention(q, k_full, v_full, lens)
@@ -209,10 +211,15 @@ class GPTModel(Layer):
                                   epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, cache_ctx=None):
-        if cache_ctx is not None and cache_ctx.mode == "decode" \
-                and position_ids is None:
-            # each slot's single token sits at that slot's own offset
-            position_ids = cache_ctx.positions()
+        if cache_ctx is not None and position_ids is None:
+            if cache_ctx.mode == "decode":
+                # each slot's single token sits at that slot's own offset
+                position_ids = cache_ctx.positions()
+            else:
+                # paged tail prefill: tokens sit past the cached prefix
+                # (None for the contiguous layout — default 0..S-1)
+                position_ids = cache_ctx.prefill_positions(
+                    input_ids.shape[-1])
         h = self.embeddings(input_ids, position_ids)
         for i, layer in enumerate(self.layers):
             if cache_ctx is not None:

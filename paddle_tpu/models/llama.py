@@ -185,24 +185,36 @@ class LlamaAttention(Layer):
             k_full, v_full, lens = cache_ctx.write_decode(k, v)
             ctx = _cached_attention(q, k_full, v_full, lens)
         else:
-            cos = Tensor._wrap(jnp.asarray(self._rope[0][:S]))
-            sin = Tensor._wrap(jnp.asarray(self._rope[1][:S]))
-            q, k = _rotary_embedding(q, k, cos, sin)
+            pos = None if cache_ctx is None else \
+                cache_ctx.prefill_positions(S)
+            if pos is None:
+                cos = Tensor._wrap(jnp.asarray(self._rope[0][:S]))
+                sin = Tensor._wrap(jnp.asarray(self._rope[1][:S]))
+                q, k = _rotary_embedding(q, k, cos, sin)
+            else:
+                # paged tail prefill: the bucket's tokens sit at absolute
+                # offsets past the cached prefix — gather full tables
+                cos = Tensor._wrap(jnp.asarray(self._rope[0]))
+                sin = Tensor._wrap(jnp.asarray(self._rope[1]))
+                q, k = _rotary_embedding(q, k, cos, sin, position_ids=pos)
 
             if cache_ctx is not None:                   # prefill
+                # post-rotary K at kv-head granularity; attention routes
+                # through the context (GQA expansion happens inside)
                 cache_ctx.write_prefill(k, v)
+                ctx = cache_ctx.prefill_attention(q, k, v)
+            else:
+                if self.n_kv != self.n_heads:
+                    rep = self.n_heads // self.n_kv
+                    k = k.unsqueeze(3) \
+                         .expand([B, S, self.n_kv, rep, self.head_dim]) \
+                         .reshape([B, S, self.n_heads, self.head_dim])
+                    v = v.unsqueeze(3) \
+                         .expand([B, S, self.n_kv, rep, self.head_dim]) \
+                         .reshape([B, S, self.n_heads, self.head_dim])
 
-            if self.n_kv != self.n_heads:
-                rep = self.n_heads // self.n_kv
-                k = k.unsqueeze(3) \
-                     .expand([B, S, self.n_kv, rep, self.head_dim]) \
-                     .reshape([B, S, self.n_heads, self.head_dim])
-                v = v.unsqueeze(3) \
-                     .expand([B, S, self.n_kv, rep, self.head_dim]) \
-                     .reshape([B, S, self.n_heads, self.head_dim])
-
-            ctx = _flash_attention(q, k, v, is_causal=True,
-                                   training=self.training)
+                ctx = _flash_attention(q, k, v, is_causal=True,
+                                       training=self.training)
         ctx = mark_sharding(ctx, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
         ctx = ctx.reshape([B, S, self.n_heads * self.head_dim])
         return self.o_proj(ctx)

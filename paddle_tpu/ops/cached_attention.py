@@ -24,7 +24,8 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
 
-__all__ = ["cached_attention"]
+__all__ = ["cached_attention", "gather_block_kv",
+           "block_prefill_attention"]
 
 
 def cached_attention(query, k_cache, v_cache, lengths, name=None):
@@ -60,3 +61,71 @@ def cached_attention(query, k_cache, v_cache, lengths, name=None):
 
     return apply_op("cached_attention", _primal,
                     [query, k_cache, v_cache, lengths])
+
+
+def gather_block_kv(pool_layer, block_tables):
+    """Gather one layer of a paged KV pool back into contiguous per-slot
+    sequences (the decode read of the paged cache).
+
+    Args:
+        pool_layer:   ``[num_blocks, block_size, Hkv, D]`` — one layer's
+                      slice of the block pool.
+        block_tables: ``[B, max_blocks]`` int32 — per-slot block ids.
+
+    Returns:
+        ``[B, max_blocks * block_size, Hkv, D]`` — each slot's sequence
+        laid out contiguous, garbage past ``lengths[b]`` (the caller's
+        attention mask never reads it).  Shapes depend only on
+        (slots, block_size, max_blocks): the gather indices are *values*,
+        so one executable serves every block-table content.
+    """
+    B, MB = block_tables.shape
+    bs = pool_layer.shape[1]
+    g = jnp.take(pool_layer, block_tables.reshape(-1), axis=0)
+    return g.reshape(B, MB * bs, *pool_layer.shape[2:])
+
+
+def block_prefill_attention(query, k_cache, v_cache, start, name=None):
+    """Tail-bucket prefill attention against a block-gathered cache.
+
+    The paged serving path prefills only the *uncached tail* of a prompt:
+    queries are the tail's S tokens at absolute positions
+    ``start .. start+S-1``, while keys/values are the slot's ENTIRE
+    gathered sequence (shared prefix blocks + the tail just written), so
+    one masked attention covers both cross-attention onto the cached
+    prefix and causal attention within the tail.
+
+    Args:
+        query:   ``[1, S, H, D]`` — tail queries (S = tail bucket).
+        k_cache: ``[1, T, Hkv, D]`` — gathered keys
+                 (``T = max_blocks_per_slot * block_size``); positions
+                 ``0..start-1`` hold the cached prefix, ``start..``
+                 the freshly-written tail.
+        v_cache: ``[1, T, Hkv, D]`` — gathered values.
+        start:   scalar int32 — absolute position of the first query.
+
+    Returns:
+        ``[1, S, H, D]`` context tensor.  GQA kv heads are repeated
+        consecutively inside, matching ``cached_attention`` bit-for-bit.
+    """
+
+    def _primal(q, k, v, st):
+        B, S, H, D = q.shape
+        T, Hkv = k.shape[1], k.shape[2]
+        if Hkv != H:
+            rep = H // Hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scale = 1.0 / (D ** 0.5)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        logits = logits.astype(jnp.float32)
+        st = jnp.asarray(st).astype(jnp.int32).reshape(())
+        qpos = st + jnp.arange(S, dtype=jnp.int32)            # [S]
+        kpos = jnp.arange(T, dtype=jnp.int32)                 # [T]
+        valid = kpos[None, :] <= qpos[:, None]                # [S, T]
+        logits = jnp.where(valid[None, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    return apply_op("block_prefill_attention", _primal,
+                    [query, k_cache, v_cache, start])

@@ -78,6 +78,19 @@ def serving_health() -> dict:
             if m.health_cb is not None}
 
 
+def serving_paging() -> dict:
+    """Paged-KV observability across every live paged engine, keyed by
+    engine name: block-pool occupancy (free/used/cached), eviction and
+    copy-on-extend counters, and prefix-cache hit rates.  Engines running
+    the contiguous layout are omitted."""
+    out = {}
+    for m in _live_serving_metrics():
+        p = m._paging_section()
+        if p is not None:
+            out[m.name] = p
+    return out
+
+
 class ProfilerState(enum.Enum):
     """Reference: profiler.py ProfilerState (:34)."""
     CLOSED = 0

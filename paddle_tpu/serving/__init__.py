@@ -22,6 +22,10 @@ retry, watchdog-backed hang detection, and ``drain()`` / ``shutdown()`` /
 See ``docs/SERVING.md`` for the architecture and an end-to-end example.
 """
 from .kv_cache import KVCache, CacheContext  # noqa: F401
+from .paging import (  # noqa: F401
+    AllocatorError, BlockAllocator, PagedCacheContext, PagedKVCache,
+)
+from .prefix_cache import PrefixCache  # noqa: F401
 from .sampling import SamplingParams, sample  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .engine import (  # noqa: F401
@@ -30,4 +34,6 @@ from .engine import (  # noqa: F401
 
 __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "SamplingParams", "ServingMetrics", "sample",
-           "QueueFull", "EngineStopped"]
+           "QueueFull", "EngineStopped",
+           "BlockAllocator", "PagedKVCache", "PagedCacheContext",
+           "PrefixCache", "AllocatorError"]

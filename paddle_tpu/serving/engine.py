@@ -95,6 +95,7 @@ class Request:
 
     # lifecycle (engine-managed)
     state: str = "queued"
+    _defers: int = 0                     # paged admissions deferred so far
     error: Optional[str] = None
     slot: Optional[int] = None
     output_ids: List[int] = field(default_factory=list)
@@ -169,6 +170,25 @@ class Engine:
             ``health()``) instead of wedging silently.
         fault_plan: a ``ServingFaultPlan`` for chaos testing; defaults to
             the env-armed plan (``PADDLE_TPU_FT_SERVING_FAULTS``).
+        kv_layout: ``"contiguous"`` (default — one ``max_seq`` stripe per
+            slot) or ``"paged"`` (block-pool KV storage addressed through
+            per-slot block tables, with refcounted cross-request prefix
+            reuse — see docs/SERVING.md "Paged KV cache").
+        block_size: tokens per KV block in paged mode; must divide
+            ``min_bucket`` (and therefore every prefill bucket).
+        num_kv_blocks: paged pool size; default
+            ``num_slots * max_seq / block_size + 1`` (contiguous-parity
+            capacity plus the reserved scratch block).
+        enable_prefix_cache: paged mode only — hash whole prompt blocks
+            host-side and serve repeated prefixes from refcounted shared
+            blocks, shrinking the prefill to the uncached tail bucket.
+        prefix_lookup_timeout_s: classifier for a degraded prefix cache:
+            a lookup that took longer than this (the lookup is
+            synchronous, so the time is already spent) is treated as a
+            failed subsystem — its result is discarded, the admission
+            proceeds as a plain miss, and ``paging.prefix_lookup_errors``
+            is counted — keeping degraded-mode behavior deterministic
+            (the same contract as a *raising* lookup).
     """
 
     def __init__(self, model, *, num_slots: int = 4,
@@ -181,7 +201,12 @@ class Engine:
                  max_step_retries: int = 1,
                  retry_backoff_s: float = 0.05,
                  step_timeout_s: Optional[float] = None,
-                 fault_plan=None):
+                 fault_plan=None,
+                 kv_layout: str = "contiguous",
+                 block_size: int = 16,
+                 num_kv_blocks: Optional[int] = None,
+                 enable_prefix_cache: bool = True,
+                 prefix_lookup_timeout_s: float = 0.25):
         cfg = getattr(model, "config", None)
         if cfg is None:
             raise TypeError("Engine needs a model carrying a .config "
@@ -216,13 +241,44 @@ class Engine:
         if cache_dtype is None:
             params = model.parameters()
             cache_dtype = params[0].dtype if params else "float32"
-        self.cache = KVCache(
-            num_slots=self.num_slots, num_layers=cfg.num_hidden_layers,
-            max_seq=self.max_seq, num_kv_heads=kv_heads,
-            head_dim=cfg.head_dim, dtype=cache_dtype)
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(f"kv_layout must be 'contiguous' or 'paged', "
+                             f"got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        self.block_size = int(block_size)
+        self.prefix_cache = None
+        self.prefix_lookup_timeout_s = float(prefix_lookup_timeout_s)
+        if kv_layout == "paged":
+            from .paging import PagedKVCache
+            from .prefix_cache import PrefixCache
+
+            if self.min_bucket % self.block_size != 0:
+                raise ValueError(
+                    f"block_size {self.block_size} must divide "
+                    f"min_bucket {self.min_bucket} (so every prefill "
+                    f"bucket is whole blocks)")
+            if self.max_seq % self.block_size != 0:
+                raise ValueError(
+                    f"block_size {self.block_size} must divide "
+                    f"max_seq {self.max_seq}")
+            self.cache = PagedKVCache(
+                num_slots=self.num_slots, num_layers=cfg.num_hidden_layers,
+                max_seq=self.max_seq, num_kv_heads=kv_heads,
+                head_dim=cfg.head_dim, dtype=cache_dtype,
+                block_size=self.block_size, num_blocks=num_kv_blocks)
+            if enable_prefix_cache:
+                self.prefix_cache = PrefixCache(self.cache.allocator,
+                                                self.block_size)
+        else:
+            self.cache = KVCache(
+                num_slots=self.num_slots, num_layers=cfg.num_hidden_layers,
+                max_seq=self.max_seq, num_kv_heads=kv_heads,
+                head_dim=cfg.head_dim, dtype=cache_dtype)
         self.name = name or f"engine-{next(_engine_counter)}"
         self.metrics = ServingMetrics(self.name, num_slots=self.num_slots)
         self.metrics.health_cb = self.health
+        if self.kv_layout == "paged":
+            self.metrics.paging_cb = self._paging_snapshot
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}
         self.free_slots: List[int] = list(range(self.num_slots))
@@ -278,17 +334,39 @@ class Engine:
 
         model, cache = self.model, self.cache
 
-        def prefill_step(input_ids, slot, length):
-            ctx = CacheContext(cache, "prefill", slot=slot, length=length)
-            logits = model(input_ids, cache_ctx=ctx)
-            cache.set_length(slot, length)
-            arr = logits._value()                       # [1, S, V]
-            last = jax.lax.dynamic_index_in_dim(
-                arr[0], length._value().astype(jnp.int32) - 1,
-                axis=0, keepdims=False)
-            return Tensor._wrap(last.astype(jnp.float32))
+        if self.kv_layout == "paged":
+            from .paging import PagedCacheContext
+
+            def prefill_step(input_ids, slot, length, start):
+                # tail-bucket prefill: tokens are the UNCACHED tail of the
+                # prompt, sitting at absolute positions start..; the last
+                # real token is at tail index (length - start - 1)
+                ctx = PagedCacheContext(cache, "prefill", slot=slot,
+                                        length=length, start=start)
+                logits = model(input_ids, cache_ctx=ctx)
+                cache.set_length(slot, length)
+                arr = logits._value()                   # [1, S, V]
+                idx = (length._value() - start._value()).astype(
+                    jnp.int32) - 1
+                last = jax.lax.dynamic_index_in_dim(
+                    arr[0], idx, axis=0, keepdims=False)
+                return Tensor._wrap(last.astype(jnp.float32))
+        else:
+            def prefill_step(input_ids, slot, length):
+                ctx = CacheContext(cache, "prefill", slot=slot,
+                                   length=length)
+                logits = model(input_ids, cache_ctx=ctx)
+                cache.set_length(slot, length)
+                arr = logits._value()                   # [1, S, V]
+                last = jax.lax.dynamic_index_in_dim(
+                    arr[0], length._value().astype(jnp.int32) - 1,
+                    axis=0, keepdims=False)
+                return Tensor._wrap(last.astype(jnp.float32))
 
         def decode_step(tokens, active):
+            # the CacheContext decode surface is layout-agnostic: the
+            # paged cache's decode_write hands back the same gathered
+            # [slots, T, Hkv, D] view cached_attention consumes
             ctx = CacheContext(cache, "decode", active=active)
             logits = model(tokens, cache_ctx=ctx)
             cache.advance(active)
@@ -427,6 +505,17 @@ class Engine:
             return f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
         if req.deadline_s is not None and req.deadline_s <= 0:
             return f"deadline_s must be > 0, got {req.deadline_s}"
+        if self.kv_layout == "paged":
+            # worst case (no prefix hit) the prompt prefills a full bucket
+            # of fresh blocks; a prompt that can never fit the pool is
+            # rejected up front instead of deferring forever
+            need = self.bucket_for(req.prompt_ids.size) // self.block_size
+            usable = self.cache.num_blocks - self.cache.allocator.reserved
+            if need > usable:
+                return (f"prompt needs {need} KV blocks "
+                        f"(bucket {self.bucket_for(req.prompt_ids.size)}, "
+                        f"block_size {self.block_size}) but the pool "
+                        f"holds {usable}")
         return None
 
     def _reject(self, req: Request, reason: str) -> None:
@@ -510,9 +599,25 @@ class Engine:
             self._build_steps()
         for b in (buckets or self.buckets):
             ids = np.zeros((1, int(b)), dtype=np.int64)
-            self._call_counted(
-                self._prefill_fn, to_tensor(ids),
-                to_tensor(np.int32(0)), to_tensor(np.int32(1)))
+            if self.kv_layout == "paged":
+                # dummy admission into slot 0: real block assignment so
+                # the traced table reads see representative state, then
+                # released — warmup registers nothing in the prefix cache
+                if not self.cache.begin_sequence(0, [], 0, int(b)):
+                    raise RuntimeError(
+                        f"warmup: pool of {self.cache.num_blocks} blocks "
+                        f"cannot hold one bucket-{b} prefill")
+                try:
+                    self._call_counted(
+                        self._prefill_fn, to_tensor(ids),
+                        to_tensor(np.int32(0)), to_tensor(np.int32(1)),
+                        to_tensor(np.int32(0)))
+                finally:
+                    self.cache.release_slot(0)
+            else:
+                self._call_counted(
+                    self._prefill_fn, to_tensor(ids),
+                    to_tensor(np.int32(0)), to_tensor(np.int32(1)))
         toks = np.zeros((self.num_slots, 1), dtype=np.int64)
         idle = np.zeros((self.num_slots,), dtype=np.int32)
         self._call_counted(self._decode_fn, to_tensor(toks), to_tensor(idle))
@@ -590,29 +695,118 @@ class Engine:
                 return False
         return True
 
-    def _admit(self, req: Request) -> None:
-        """Prefill ``req`` into its pre-assigned slot.  Never raises for
-        request-level problems — a prefill/sampling/callback failure fails
-        this request only (``_retire`` reclaims the slot)."""
-        if req._cancel:                  # cancelled between pop and prefill
-            self._retire(req, "cancelled")
-            return
-        L = int(req.prompt_ids.size)
-        bucket = self.bucket_for(L)
-        ids = np.zeros((1, bucket), dtype=np.int64)
-        ids[0, :L] = req.prompt_ids
+    def _prefix_lookup(self, req: Request):
+        """Longest cached prefix of the prompt, ``(n_tokens, block_ids)``.
+        A raising or over-budget lookup degrades to a miss: the request
+        still completes with a full prefill, the error is only counted
+        (``paging.prefix_lookup_errors``), and no block was referenced.
+        Hit-rate accounting happens in ``_paged_prefill`` AFTER the
+        partial-hit cap, so the gauge only ever credits blocks that are
+        actually reused — a discarded (raising/over-budget) result is
+        recorded as a plain miss there."""
+        if self.prefix_cache is None:
+            return 0, []
         t0 = time.perf_counter()
         try:
-            last = self._step_call(
-                "serving.prefill", self._prefill_fn, to_tensor(ids),
-                to_tensor(np.int32(req.slot)), to_tensor(np.int32(L)))
+            self._fault("serving.prefix_lookup")
+            hit_tokens, blocks = self.prefix_cache.lookup(
+                req.prompt_ids, count=False)
+        except Exception:                # noqa: BLE001 — isolation boundary
+            self.metrics.on_prefix_lookup_error()
+            return 0, []
+        if time.perf_counter() - t0 > self.prefix_lookup_timeout_s:
+            # over-budget = degraded subsystem: discard the (late) result
+            # and serve a deterministic plain miss, same as a raising
+            # lookup (the stall itself is sunk cost — the lookup is
+            # synchronous and cannot be pre-empted)
+            self.metrics.on_prefix_lookup_error()
+            return 0, []
+        return hit_tokens, blocks
+
+    def _prefill_call(self, req: Request, *args):
+        """One compiled prefill with the bounded retry; exhausted retries
+        retire ``req`` as failed and return None (shared by both KV
+        layouts so the retire semantics cannot diverge)."""
+        try:
+            return self._step_call("serving.prefill", self._prefill_fn,
+                                   *args)
         except Exception as e:           # noqa: BLE001 — isolation boundary
+            n = self.max_step_retries
             self._retire(req, "failed",
-                         error=f"prefill failed after "
-                               f"{self.max_step_retries} retr"
-                               f"{'y' if self.max_step_retries == 1 else 'ies'}"
-                               f": {type(e).__name__}: {e}")
-            return
+                         error=f"prefill failed after {n} "
+                               f"retr{'y' if n == 1 else 'ies'}: "
+                               f"{type(e).__name__}: {e}")
+            return None
+
+    def _paged_prefill(self, req: Request, L: int):
+        """Paged admission: prefix lookup, block assignment, tail-bucket
+        prefill.  Returns ``(status, last_logits, bucket)`` with status
+        ``"ok" | "deferred" | "failed"`` (``deferred`` = the pool cannot
+        supply the tail blocks right now and the slot was left untouched;
+        ``failed`` = the request was already retired)."""
+        P, shared = self._prefix_lookup(req)
+        bucket = self.bucket_for(L - P)
+        # a PARTIAL hit can push prefix + padded tail past the slot's
+        # block table (e.g. hit 8 of a 32-token prompt with buckets
+        # {8,16,32}: 1 + 32/8 = 5 blocks on a 4-block table) — drop hit
+        # blocks from the end until the padded tail fits; the remaining
+        # hit is still a contiguous prefix
+        while shared and (len(shared) + bucket // self.block_size
+                          > self.cache.max_blocks_per_slot):
+            shared = shared[:-1]
+            P -= self.block_size
+            bucket = self.bucket_for(L - P)
+        if self.prefix_cache is not None and req._defers == 0:
+            # one logical lookup per request (deferral retries re-look-up
+            # for freshness but don't re-count), credited with only the
+            # hit span that is ACTUALLY reused post-cap — discarded and
+            # raising lookups land here as P == 0, i.e. a plain miss
+            self.prefix_cache.record_lookup(L, P)
+        if not self.cache.begin_sequence(req.slot, shared, P, bucket):
+            return "deferred", None, bucket
+        ids = np.zeros((1, bucket), dtype=np.int64)
+        ids[0, :L - P] = req.prompt_ids[P:]
+        last = self._prefill_call(
+            req, to_tensor(ids), to_tensor(np.int32(req.slot)),
+            to_tensor(np.int32(L)), to_tensor(np.int32(P)))
+        if last is None:
+            return "failed", None, bucket
+        if self.prefix_cache is not None:
+            # make this prompt's whole blocks hittable by later requests
+            # (hit blocks are refreshed, new full tail blocks registered)
+            try:
+                self.prefix_cache.register(
+                    req.prompt_ids, self.cache._slot_blocks[req.slot])
+            except Exception:            # noqa: BLE001 — isolation boundary
+                self.metrics.on_prefix_register_error()
+        return "ok", last, bucket
+
+    def _admit(self, req: Request) -> Optional[bool]:
+        """Prefill ``req`` into its pre-assigned slot.  Never raises for
+        request-level problems — a prefill/sampling/callback failure fails
+        this request only (``_retire`` reclaims the slot).  Returns False
+        when paged admission must be deferred (no KV blocks free); the
+        scheduler re-queues the request with its slot returned."""
+        if req._cancel:                  # cancelled between pop and prefill
+            self._retire(req, "cancelled")
+            return None
+        L = int(req.prompt_ids.size)
+        t0 = time.perf_counter()
+        if self.kv_layout == "paged":
+            status, last, bucket = self._paged_prefill(req, L)
+            if status == "deferred":
+                return False
+            if status == "failed":
+                return None
+        else:
+            bucket = self.bucket_for(L)
+            ids = np.zeros((1, bucket), dtype=np.int64)
+            ids[0, :L] = req.prompt_ids
+            last = self._prefill_call(
+                req, to_tensor(ids), to_tensor(np.int32(req.slot)),
+                to_tensor(np.int32(L)))
+            if last is None:
+                return None
         logits = last.numpy()
         now = time.perf_counter()
         self.metrics.prefill_time_s += now - t0
@@ -661,6 +855,15 @@ class Engine:
             self.running.pop(slot, None)
             if slot not in self.free_slots:
                 self.free_slots.append(slot)
+            if self.kv_layout == "paged":
+                # drop the slot's block refs (idempotent); blocks also
+                # registered in the prefix cache stay alive on its ref
+                try:
+                    self.cache.release_slot(slot)
+                except Exception as e:   # noqa: BLE001 — accounting bug
+                    self._mark_block_corruption(
+                        f"release_slot({slot}) failed: "
+                        f"{type(e).__name__}: {e}")
         if state == "finished":
             self.metrics.on_complete()
         elif state == "cancelled":
@@ -668,7 +871,39 @@ class Engine:
         elif state == "failed":
             self.metrics.on_fail()
 
+    def _mark_block_corruption(self, reason: str) -> None:
+        """A block-accounting violation is engine-fatal for trust (not
+        for liveness): surface it sticky via health() instead of
+        corrupting the pool silently."""
+        if self.state != "unhealthy":
+            self.state = "unhealthy"
+            self._unhealthy_reason = f"KV block accounting: {reason}"
+
+    def _prepare_decode_paged(self) -> None:
+        """Host-side block maintenance before a paged decode step: each
+        running slot's next write position must land on a block it owns
+        exclusively — growing sequences get a fresh block, shared blocks
+        are copied-on-extend.  A slot the pool cannot serve fails (the
+        engine and its batch continue)."""
+        for slot, req in list(self.running.items()):
+            try:
+                ok = self.cache.ensure_capacity(slot, req._seq_len)
+            except Exception as e:       # noqa: BLE001 — accounting bug
+                self._mark_block_corruption(
+                    f"ensure_capacity({slot}) failed: "
+                    f"{type(e).__name__}: {e}")
+                ok = False
+            if not ok:
+                self._retire(req, "failed",
+                             error="KV block pool exhausted: no block "
+                                   f"free for position {req._seq_len} "
+                                   "(even after prefix-cache eviction)")
+
     def _decode(self) -> None:
+        if self.kv_layout == "paged":
+            self._prepare_decode_paged()
+            if not self.running:
+                return
         toks = np.zeros((self.num_slots, 1), dtype=np.int64)
         active = np.zeros((self.num_slots,), dtype=np.int32)
         for slot in self.running:
@@ -727,7 +962,7 @@ class Engine:
                 continue
             req.slot = self.free_slots.pop()
             try:
-                self._admit(req)
+                deferred = self._admit(req) is False
             except BaseException:
                 # _admit isolates request-level failures itself; this is
                 # the guarantee that even an engine-level bug (or
@@ -736,6 +971,23 @@ class Engine:
                     self._retire(req, "failed",
                                  error="admission aborted by engine error")
                 raise
+            if deferred:
+                # paged mode: the pool has no blocks for this prompt right
+                # now — hand the slot back and retry once running work
+                # retires (head-of-line FCFS).  With nothing running, no
+                # block can ever free (eviction was already attempted
+                # inside alloc), so fail instead of spinning forever.
+                self.free_slots.append(req.slot)
+                req.slot = None
+                req._defers += 1
+                if self.running:
+                    self.queue.appendleft(req)
+                else:
+                    self._retire(req, "failed",
+                                 error="KV block pool exhausted: prompt "
+                                       "needs more free blocks than the "
+                                       "pool can supply")
+                break
         self.metrics.on_slots(len(self.running))
         if self.running:
             self._decode()
@@ -809,12 +1061,52 @@ class Engine:
             self._watchdog.stop()
             self._watchdog = None
 
+    def _paging_snapshot(self) -> dict:
+        """The paged-KV observability payload (``stats()["paging"]`` and
+        ``profiler.serving_paging()``): block-pool occupancy, eviction and
+        copy-on-extend counters, and the prefix-cache hit counters."""
+        al = self.cache.allocator.stats()
+        return {
+            "kv_layout": "paged",
+            "block_size": self.block_size,
+            "max_blocks_per_slot": self.cache.max_blocks_per_slot,
+            "blocks": al,
+            "blocks_in_use": al["used"] + al["cached"],
+            "copy_on_extends": self.cache.copy_on_extends,
+            "prefix": (self.prefix_cache.stats()
+                       if self.prefix_cache is not None else None),
+        }
+
     def health(self) -> dict:
         """Liveness snapshot: engine state, last-step age, consecutive
         compiled-step failures, and capacity gauges — the probe a load
-        balancer or the profiler surface polls."""
+        balancer or the profiler surface polls.  In paged mode it also
+        audits the block allocator's invariants (free + used + cached ==
+        total − reserved, no negative refcounts, no slot holding a freed
+        block) and flips the engine ``unhealthy`` on any violation
+        instead of letting the pool corrupt silently."""
+        paged_extra = {}
+        if self.kv_layout == "paged":
+            violations = self.cache.check_invariants()
+            if violations:
+                # health() may be polled from a monitor thread while the
+                # scheduler is mid-way through a multi-op accounting
+                # change (block popped, refcount not yet set): confirm on
+                # a re-read before declaring the pool corrupt — a
+                # transient snapshot clears, real corruption persists
+                violations = self.cache.check_invariants()
+            if violations:
+                self._mark_block_corruption("; ".join(violations))
+            al = self.cache.allocator.stats()
+            paged_extra = {
+                "kv_blocks": {k: al[k] for k in
+                              ("total", "reserved", "free", "used",
+                               "cached")},
+                "kv_block_invariants": violations or "ok",
+            }
         now = time.perf_counter()
         return {
+            **paged_extra,
             "state": self.state,
             "reason": self._unhealthy_reason,
             "steps": self._step_counter,

@@ -175,3 +175,30 @@ class CacheContext:
         """Current token positions ``[slots, 1]`` (pre-advance lengths) —
         position ids for learned embeddings / rotary offsets in decode."""
         return Tensor._wrap(self.cache.lengths._value()[:, None])
+
+    # -- prefill routing hooks (overridden by serving.PagedCacheContext) --
+
+    def prefill_positions(self, seq_len: int) -> Optional[Tensor]:
+        """Position ids for the prefill tokens, or None for the default
+        ``0..S-1`` — the paged context offsets them past its cached
+        prefix.  ``seq_len`` is a trace-time python constant."""
+        return None
+
+    def prefill_attention(self, q, k, v):
+        """Prompt-forward attention.  The contiguous layout is ordinary
+        causal attention (GQA kv heads expanded first, exactly like the
+        models' no-cache path); the paged context overrides this with a
+        gather-by-block-table attention that also covers its cached
+        prefix."""
+        from ..ops.pallas import flash_attention
+
+        B, S, H, _ = q.shape
+        Hkv = k.shape[2]
+        if Hkv != H:
+            rep = H // Hkv
+            D = q.shape[3]
+            k = k.unsqueeze(3).expand([B, S, Hkv, rep, D]) \
+                 .reshape([B, S, H, D])
+            v = v.unsqueeze(3).expand([B, S, Hkv, rep, D]) \
+                 .reshape([B, S, H, D])
+        return flash_attention(q, k, v, is_causal=True, training=False)

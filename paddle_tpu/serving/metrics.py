@@ -62,6 +62,12 @@ class ServingMetrics:
         self.retries_by_point: Dict[str, int] = {}
         # engine-provided liveness snapshot (set by serving.Engine)
         self.health_cb = None
+        # paged-KV observability (set by serving.Engine in paged mode):
+        # block-pool occupancy, eviction, copy-on-extend, and prefix-hit
+        # counters, exported as the snapshot's "paging" section
+        self.paging_cb = None
+        self.prefix_lookup_errors = 0
+        self.prefix_register_errors = 0
         self.prefill_tokens = 0
         self.decode_tokens = 0
         self.decode_steps = 0
@@ -124,6 +130,18 @@ class ServingMetrics:
     def on_callback_error(self) -> None:
         self.callback_errors += 1
 
+    def on_prefix_lookup_error(self) -> None:
+        """A raising/over-budget prefix-cache lookup degraded to a miss
+        (the request still prefills its full prompt)."""
+        self.prefix_lookup_errors += 1
+
+    def on_prefix_register_error(self) -> None:
+        """Registering a prompt's blocks for future reuse failed — the
+        request itself is unaffected, future requests just can't hit
+        this prompt.  Counted apart from lookup errors so the two
+        degradation modes stay distinguishable on a dashboard."""
+        self.prefix_register_errors += 1
+
     def on_step_failure(self, point: str) -> None:
         self.step_failures += 1
 
@@ -148,6 +166,15 @@ class ServingMetrics:
     def tokens_per_sec(self) -> float:
         return self.decode_tokens / self.decode_time_s \
             if self.decode_time_s > 0 else 0.0
+
+    def _paging_section(self):
+        """Engine-fed paged-KV gauges (None for the contiguous layout)."""
+        if self.paging_cb is None:
+            return None
+        out = self.paging_cb()
+        out["prefix_lookup_errors"] = self.prefix_lookup_errors
+        out["prefix_register_errors"] = self.prefix_register_errors
+        return out
 
     def snapshot(self) -> dict:
         """The ``/stats`` endpoint payload: one JSON-ready dict.  Latency
@@ -176,6 +203,7 @@ class ServingMetrics:
             },
             "health": self.health_cb() if self.health_cb is not None
             else None,
+            "paging": self._paging_section(),
             "queue_depth": self.queue_depth,
             "queue_depth_max": self.queue_depth_max,
             "slot_occupancy": round(occ, 4),

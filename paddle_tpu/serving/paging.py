@@ -1,0 +1,486 @@
+"""Paged KV cache: a block-granular pool behind the CacheContext surface.
+
+Instead of reserving a contiguous ``max_seq`` stripe per slot (the
+:class:`~.kv_cache.KVCache` layout — HBM sized for the worst-case
+sequence), the paged layout stores K/V in a fixed pool of
+``[num_blocks, layers, block_size, kv_heads, head_dim]`` blocks and
+addresses them through per-slot int32 block tables of fixed shape
+``[slots, max_blocks_per_slot]``.  Two things fall out:
+
+- **HBM scales with live tokens, not worst-case slots** — the same pool
+  holds many more concurrent sequences when most are short; and
+- **blocks are refcountable**, so identical prompt prefixes across
+  requests (system prompts, few-shot headers) can share storage via
+  :class:`~.prefix_cache.PrefixCache` instead of being re-prefilled.
+
+The zero-recompile invariant survives because every compiled shape is a
+function of ``(slots, bucket, block_size, max_blocks_per_slot)`` only:
+block ids live *inside* the block-table tensor (device state threaded
+through traces exactly like the contiguous cache's payloads), and all
+allocation/eviction/copy-on-extend happens host-side between steps,
+changing argument *values* only.
+
+Write discipline (same contract as the contiguous cache, block-indirect):
+prefill writes whole tail-bucket blocks starting at the block boundary
+``start_pos // block_size``; decode writes each slot's token at
+``lengths[slot]`` through the table; attention reads positions
+``<= lengths[slot]`` via gather-by-block-table.  Block 0 is a reserved
+scratch block: idle slots' table rows point at it, so the all-slots
+fixed-shape decode write never touches a live block.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+from ..ops.cached_attention import (
+    block_prefill_attention, gather_block_kv,
+)
+from .kv_cache import CacheContext, _as_i32
+
+__all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheContext",
+           "AllocatorError"]
+
+#: Block id every idle/retired slot's table points at.  Never allocated.
+SCRATCH_BLOCK = 0
+
+
+class AllocatorError(RuntimeError):
+    """A block-accounting invariant was about to be violated (double
+    free, unref of a free block, ...).  The engine surfaces this as an
+    unhealthy state instead of corrupting the pool silently."""
+
+
+class BlockAllocator:
+    """Host-side accounting for the fixed KV block pool.
+
+    Blocks move between three disjoint states (plus the reserved scratch
+    block): **free** (refcount 0, on the free list), **used** (referenced
+    by at least one live slot), and **cached** (idle but retained by the
+    prefix cache, which holds their single ref).  ``free + used + cached
+    == total - reserved`` at every step — :meth:`check` verifies it and
+    :meth:`stats` exports the gauges.
+
+    When the free list runs dry, :meth:`alloc` asks ``evict_cb`` (wired
+    to :meth:`PrefixCache._evict_for_alloc`) to release idle cached
+    blocks, LRU-first.
+    """
+
+    def __init__(self, num_blocks: int, reserved: int = 1):
+        if num_blocks < reserved + 1:
+            raise ValueError(f"num_blocks must be > reserved={reserved}, "
+                             f"got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.reserved = int(reserved)
+        self._free: deque = deque(range(self.reserved, self.num_blocks))
+        self._ref = [0] * self.num_blocks
+        self._cached = set()            # block ids retained by PrefixCache
+        self.evict_cb: Optional[Callable[[int], int]] = None
+        # counters
+        self.allocs = 0
+        self.frees = 0
+        self.alloc_failures = 0
+
+    # -- core ops ----------------------------------------------------------
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` blocks (refcount 1 each).  Evicts idle cached blocks
+        under pressure; returns None (all-or-nothing) if the pool cannot
+        supply ``n`` blocks even after eviction."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if len(self._free) < n and self.evict_cb is not None:
+            self.evict_cb(n - len(self._free))
+        if len(self._free) < n:
+            self.alloc_failures += 1
+            return None
+        out = []
+        for _ in range(n):
+            b = self._free.popleft()
+            self._ref[b] = 1
+            out.append(b)
+        self.allocs += n
+        return out
+
+    def ref(self, block_id: int) -> int:
+        b = self._check_id(block_id)
+        if self._ref[b] < 1:
+            raise AllocatorError(f"ref of free block {b}")
+        self._ref[b] += 1
+        return self._ref[b]
+
+    def unref(self, block_id: int) -> int:
+        b = self._check_id(block_id)
+        if self._ref[b] < 1:
+            raise AllocatorError(f"double free of block {b}")
+        self._ref[b] -= 1
+        if self._ref[b] == 0:
+            if b in self._cached:
+                raise AllocatorError(
+                    f"cached block {b} dropped to refcount 0: the prefix "
+                    "cache must hold one ref per cached block")
+            self._free.append(b)
+            self.frees += 1
+        return self._ref[b]
+
+    def refcount(self, block_id: int) -> int:
+        return self._ref[self._check_id(block_id)]
+
+    def _check_id(self, block_id: int) -> int:
+        b = int(block_id)
+        if not (self.reserved <= b < self.num_blocks):
+            raise AllocatorError(
+                f"block id {b} out of pool range "
+                f"[{self.reserved}, {self.num_blocks})")
+        return b
+
+    # -- prefix-cache bookkeeping -----------------------------------------
+
+    def mark_cached(self, block_id: int) -> None:
+        self._cached.add(self._check_id(block_id))
+
+    def unmark_cached(self, block_id: int) -> None:
+        self._cached.discard(self._check_id(block_id))
+
+    # -- introspection / invariants ---------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def stats(self) -> dict:
+        cached_idle = sum(1 for b in self._cached if self._ref[b] == 1)
+        used = sum(1 for b in range(self.reserved, self.num_blocks)
+                   if self._ref[b] > 0) - cached_idle
+        return {
+            "total": self.num_blocks,
+            "reserved": self.reserved,
+            "free": len(self._free),
+            "used": used,
+            "cached": cached_idle,
+            "allocs": self.allocs,
+            "frees": self.frees,
+            "alloc_failures": self.alloc_failures,
+        }
+
+    def check(self) -> List[str]:
+        """Invariant audit; a non-empty return means the pool is corrupt
+        (the engine flips unhealthy on it)."""
+        out = []
+        neg = [b for b, r in enumerate(self._ref) if r < 0]
+        if neg:
+            out.append(f"negative refcounts on blocks {neg}")
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            out.append("duplicate entries on the free list")
+        both = [b for b in free_set if self._ref[b] != 0]
+        if both:
+            out.append(f"blocks {both} free-listed with nonzero refcount")
+        s = self.stats()
+        if s["free"] + s["used"] + s["cached"] != s["total"] - s["reserved"]:
+            out.append(
+                f"accounting leak: free({s['free']}) + used({s['used']}) "
+                f"+ cached({s['cached']}) != total({s['total']}) - "
+                f"reserved({s['reserved']})")
+        uncached_idle = [b for b in self._cached if self._ref[b] == 0]
+        if uncached_idle:
+            out.append(f"cached blocks {uncached_idle} with refcount 0")
+        return out
+
+
+class PagedKVCache:
+    """Block-pool KV storage exposing the :class:`KVCache` duck surface.
+
+    Device state (threaded through compiled programs exactly like the
+    contiguous cache): the K/V pools, the ``[slots, max_blocks_per_slot]``
+    int32 block tables, and the ``[slots]`` lengths.  Host state: the
+    :class:`BlockAllocator` and each slot's owned-block list.
+    """
+
+    def __init__(self, num_slots: int, num_layers: int, max_seq: int,
+                 num_kv_heads: int, head_dim: int, dtype="float32", *,
+                 block_size: int = 16, num_blocks: Optional[int] = None):
+        if num_slots < 1 or num_layers < 1 or max_seq < 1:
+            raise ValueError("num_slots/num_layers/max_seq must be >= 1")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_seq % block_size != 0:
+            raise ValueError(f"max_seq={max_seq} must be a multiple of "
+                             f"block_size={block_size}")
+        self.num_slots = int(num_slots)
+        self.num_layers = int(num_layers)
+        self.max_seq = int(max_seq)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.max_blocks_per_slot = self.max_seq // self.block_size
+        if num_blocks is None:
+            # contiguous-parity capacity + the reserved scratch block; the
+            # prefix cache then *saves* blocks relative to this baseline
+            num_blocks = self.num_slots * self.max_blocks_per_slot + 1
+        self.num_blocks = int(num_blocks)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.allocator = BlockAllocator(self.num_blocks, reserved=1)
+        shape = (self.num_blocks, self.num_layers, self.block_size,
+                 self.num_kv_heads, self.head_dim)
+        self.k = Tensor._wrap(jnp.zeros(shape, dtype=self.dtype))
+        self.v = Tensor._wrap(jnp.zeros(shape, dtype=self.dtype))
+        self.block_tables = Tensor._wrap(jnp.full(
+            (self.num_slots, self.max_blocks_per_slot), SCRATCH_BLOCK,
+            dtype=jnp.int32))
+        self.lengths = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        for t in (self.k, self.v, self.block_tables, self.lengths):
+            t.persistable = True
+        #: blocks each slot owns one ref on, by table index order
+        self._slot_blocks: List[List[int]] = [[] for _ in range(num_slots)]
+        self.copy_on_extends = 0
+
+    # -- host-side slot lifecycle -----------------------------------------
+
+    def _set_table(self, slot: int, idx: int, block_id: int) -> None:
+        self.block_tables._set_data(
+            self.block_tables._value().at[slot, idx].set(
+                jnp.int32(block_id)))
+
+    def begin_sequence(self, slot: int, shared_blocks: Sequence[int],
+                       prefix_len: int, tail_bucket: int) -> bool:
+        """Assign storage for one admission: ref the shared prefix blocks
+        and allocate fresh blocks covering the whole tail bucket.  The
+        slot must be empty (freshly popped).  All-or-nothing: returns
+        False (slot untouched) when the pool cannot supply the tail —
+        the scheduler defers the request instead of failing it."""
+        if self._slot_blocks[slot]:
+            raise AllocatorError(f"slot {slot} already owns blocks "
+                                 f"{self._slot_blocks[slot]}")
+        bs = self.block_size
+        if prefix_len != len(shared_blocks) * bs:
+            raise ValueError(f"prefix_len {prefix_len} != "
+                             f"{len(shared_blocks)} shared blocks * {bs}")
+        if tail_bucket % bs != 0:
+            raise ValueError(f"tail bucket {tail_bucket} not a multiple "
+                             f"of block_size {bs}")
+        n_tail = tail_bucket // bs
+        n_total = len(shared_blocks) + n_tail
+        if n_total > self.max_blocks_per_slot:
+            raise ValueError(
+                f"prefix {len(shared_blocks)} + tail {n_tail} blocks "
+                f"exceed max_blocks_per_slot {self.max_blocks_per_slot}")
+        # ref the hit blocks BEFORE allocating the tail: alloc() may evict
+        # idle cached blocks under pressure, and an un-ref'd hit block is
+        # exactly that — pinning first makes the lookup result immune to
+        # being recycled into this same sequence's tail
+        owned = []
+        for b in shared_blocks:
+            self.allocator.ref(int(b))
+            owned.append(int(b))
+        fresh = self.allocator.alloc(n_tail)
+        if fresh is None:
+            for b in owned:
+                self.allocator.unref(b)
+            return False
+        owned.extend(fresh)
+        tbl = self.block_tables._value()
+        row = [SCRATCH_BLOCK] * self.max_blocks_per_slot
+        row[:len(owned)] = owned
+        self.block_tables._set_data(
+            tbl.at[slot].set(jnp.asarray(row, dtype=jnp.int32)))
+        self._slot_blocks[slot] = owned
+        return True
+
+    def release_slot(self, slot: int) -> None:
+        """Drop the slot's refs and point its table back at scratch.
+        Idempotent (retire is the single exit path, but chaos paths may
+        race a reset)."""
+        owned, self._slot_blocks[slot] = self._slot_blocks[slot], []
+        for b in owned:
+            self.allocator.unref(b)
+        if owned:
+            self.block_tables._set_data(
+                self.block_tables._value().at[slot].set(
+                    jnp.full((self.max_blocks_per_slot,), SCRATCH_BLOCK,
+                             dtype=jnp.int32)))
+        self.lengths._set_data(
+            self.lengths._value().at[slot].set(jnp.int32(0)))
+
+    def ensure_capacity(self, slot: int, next_pos: int) -> bool:
+        """Make position ``next_pos`` writable for ``slot`` before a
+        decode step: allocate the covering block if the sequence is
+        growing into one it doesn't own yet, and copy-on-extend if the
+        covering block is shared (refcount > 1).  Returns False when the
+        pool is exhausted (the engine fails that request, not the
+        engine)."""
+        bidx = next_pos // self.block_size
+        if bidx >= self.max_blocks_per_slot:
+            return False                 # capacity guard upstream
+        owned = self._slot_blocks[slot]
+        if bidx >= len(owned):
+            if bidx != len(owned):
+                raise AllocatorError(
+                    f"slot {slot} skipping block index {len(owned)} "
+                    f"to {bidx}")
+            fresh = self.allocator.alloc(1)
+            if fresh is None:
+                return False
+            owned.append(fresh[0])
+            self._set_table(slot, bidx, fresh[0])
+            return True
+        block_id = owned[bidx]
+        if self.allocator.refcount(block_id) > 1:
+            # copy-on-extend: appending into a shared block would corrupt
+            # the other holders' view — give this slot a private copy
+            fresh = self.allocator.alloc(1)
+            if fresh is None:
+                return False
+            for buf in (self.k, self.v):
+                arr = buf._value()
+                buf._set_data(arr.at[fresh[0]].set(arr[block_id]))
+            owned[bidx] = fresh[0]
+            self._set_table(slot, bidx, fresh[0])
+            self.allocator.unref(block_id)
+            self.copy_on_extends += 1
+        return True
+
+    def reset(self) -> None:
+        """Forget all sequences: release every slot and zero lengths.
+        Cached (prefix) blocks are left to their owner — the engine
+        clears its PrefixCache separately when it wants a cold pool."""
+        for slot in range(self.num_slots):
+            self.release_slot(slot)
+
+    # -- traced state ops (CacheContext surface) --------------------------
+
+    def prefill_write(self, layer_idx: int, slot, k, v, start=0) -> None:
+        """Write a tail bucket's K/V through the block table.
+
+        ``k``/``v``: ``[1, S, Hkv, D]`` with S = tail bucket (a multiple
+        of block_size); ``slot``/``start`` scalar ints (may be traced) —
+        ``start`` is the absolute position of the bucket's first token
+        and is always a block boundary."""
+        s = _as_i32(slot).reshape(())
+        st = _as_i32(start).reshape(())
+        bs = self.block_size
+        li = jnp.int32(layer_idx)
+        tbl = self.block_tables._value()
+        row = jax.lax.dynamic_index_in_dim(tbl, s, axis=0, keepdims=False)
+        start_block = st // bs
+        for buf, new in ((self.k, k), (self.v, v)):
+            arr = buf._value()
+            upd = new._value().astype(arr.dtype)[0]     # [S, Hkv, D]
+            n_blocks = upd.shape[0] // bs
+            for j in range(n_blocks):                   # python const
+                bid = jax.lax.dynamic_index_in_dim(
+                    row, start_block + j, axis=0, keepdims=False)
+                blk = upd[j * bs:(j + 1) * bs]          # [bs, Hkv, D]
+                arr = jax.lax.dynamic_update_slice(
+                    arr, blk[None, None].astype(arr.dtype),
+                    (bid, li, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+            buf._set_data(arr)
+
+    def set_length(self, slot, length) -> None:
+        s = _as_i32(slot).reshape(())
+        ln = _as_i32(length).reshape(())
+        self.lengths._set_data(self.lengths._value().at[s].set(ln))
+
+    def decode_write(self, layer_idx: int, k, v
+                     ) -> Tuple[Tensor, Tensor, Tensor]:
+        """Write one token per slot at ``lengths[slot]`` through the
+        table, then gather each slot's sequence back contiguous —
+        returning the same ``([slots, T, Hkv, D], lengths)`` triple the
+        contiguous cache hands ``ops.cached_attention``, with
+        ``T = max_blocks_per_slot * block_size``.  Idle slots' tables
+        point at the scratch block, so the fixed-shape all-slots write
+        never lands on live storage."""
+        lens = self.lengths._value()
+        bs = self.block_size
+        tbl = self.block_tables._value()            # [slots, max_blocks]
+        bidx = jnp.clip(lens // bs, 0, self.max_blocks_per_slot - 1)
+        block_ids = jnp.take_along_axis(
+            tbl, bidx[:, None], axis=1)[:, 0]       # [slots]
+        off = lens % bs
+        outs = []
+        for buf, new in ((self.k, k), (self.v, v)):
+            arr = buf._value()
+            upd = new._value().astype(arr.dtype)[:, 0]   # [slots, Hkv, D]
+            arr = arr.at[block_ids, layer_idx, off].set(upd)
+            buf._set_data(arr)
+            outs.append(Tensor._wrap(
+                gather_block_kv(arr[:, layer_idx], tbl)))
+        return outs[0], outs[1], Tensor._wrap(lens)
+
+    def advance(self, active) -> None:
+        mask = _as_i32(active)
+        self.lengths._set_data(self.lengths._value() + mask)
+
+    # -- host-side management ---------------------------------------------
+
+    def length_of(self, slot: int) -> int:
+        return int(self.lengths.numpy()[slot])
+
+    def nbytes(self) -> int:
+        itemsize = jnp.zeros((), dtype=self.dtype).dtype.itemsize
+        return 2 * self.num_blocks * self.num_layers * self.block_size * \
+            self.num_kv_heads * self.head_dim * itemsize
+
+    def blocks_in_use(self) -> int:
+        s = self.allocator.stats()
+        return s["used"] + s["cached"]
+
+    def check_invariants(self) -> List[str]:
+        """Allocator audit plus cache-level cross-checks."""
+        out = self.allocator.check()
+        seen = {}
+        for slot, owned in enumerate(self._slot_blocks):
+            for b in owned:
+                seen.setdefault(b, []).append(slot)
+                if self.allocator.refcount(b) < 1:
+                    out.append(f"slot {slot} holds freed block {b}")
+        for b, slots in seen.items():
+            if len(slots) > self.allocator.refcount(b):
+                out.append(f"block {b} held by slots {slots} with only "
+                           f"{self.allocator.refcount(b)} refs")
+        return out
+
+
+@dataclass
+class PagedCacheContext(CacheContext):
+    """CacheContext over a :class:`PagedKVCache`: same duck surface, plus
+    the tail-prefill routing (``start`` = absolute position of the
+    bucket's first token, a traced scalar — block ids stay inside the
+    block-table tensor)."""
+
+    start: Optional[Tensor] = None              # prefill: scalar int32
+
+    def write_prefill(self, k, v) -> None:
+        self.cache.prefill_write(self.layer_idx, self.slot, k, v,
+                                 self.start if self.start is not None
+                                 else 0)
+
+    def prefill_positions(self, seq_len: int) -> Optional[Tensor]:
+        """Absolute positions of the tail bucket's tokens ``[1, S]`` —
+        offset by the cached-prefix length."""
+        st = _as_i32(self.start if self.start is not None else 0
+                     ).reshape(())
+        return Tensor._wrap(
+            (st + jnp.arange(seq_len, dtype=jnp.int32))[None, :])
+
+    def prefill_attention(self, q, k, v):
+        """Tail queries attending over the slot's whole block table
+        (cached prefix + freshly-written tail) with an absolute-position
+        causal mask.  GQA expansion happens inside the op, like the
+        decode kernel."""
+        s = _as_i32(self.slot).reshape(())
+        tbl = self.cache.block_tables._value()
+        row = jax.lax.dynamic_index_in_dim(tbl, s, axis=0)   # [1, MB]
+        k_all = Tensor._wrap(gather_block_kv(
+            self.cache.k._value()[:, self.layer_idx], row))
+        v_all = Tensor._wrap(gather_block_kv(
+            self.cache.v._value()[:, self.layer_idx], row))
+        start = self.start if self.start is not None else 0
+        return block_prefill_attention(q, k_all, v_all, start)

@@ -1,0 +1,227 @@
+"""Cross-request prefix reuse: a host-side hash-chained block cache.
+
+``PrefixCache`` maps whole prompt token *blocks* to KV-pool block ids so a
+request whose prompt starts with a previously-served prefix skips
+re-prefilling the shared span: the engine looks the prompt up, maps the
+hit to refcounted shared blocks in the :class:`~.paging.BlockAllocator`
+pool, and prefills only the uncached tail bucket.
+
+Design points (all host-side — nothing here ever enters a trace, so the
+zero-recompile property of the serving engine is untouchable from this
+module):
+
+- **Whole blocks only.**  A block of ``block_size`` tokens is the unit of
+  both storage and matching: partial-block hits would share K/V lines that
+  a later request must append into, which is exactly the aliasing the
+  block-granular design avoids.
+- **Hash-chained keys.**  Block ``i``'s key is
+  ``H(key[i-1] || tokens[i*bs:(i+1)*bs])``, so a lookup hit is always a
+  *contiguous prefix*: the walk stops at the first absent link and can
+  never skip-match an interior block.
+- **Capped below the full prompt.**  At most ``(len(prompt) - 1) // bs``
+  blocks can hit, so the uncached tail always holds >= 1 token — the
+  engine still runs a real prefill and gets first-token logits, and a
+  tail write never lands inside a shared block (copy-on-extend stays a
+  defensive path, not a steady-state one).
+- **One reference per cached block.**  Registering a block takes a single
+  allocator ref on behalf of the cache; live slots stack their own refs
+  on top.  Evicting an entry drops only the cache's ref — blocks still
+  referenced by running requests stay alive (they just stop being
+  hittable).
+- **LRU, leaf-first eviction.**  Entries are kept in recency order and
+  only chain *leaves* (entries with no cached children) are evictable, so
+  the cache always stores contiguous chains; candidates must also be
+  idle (refcount 1 — the cache's own ref) or evicting them would free
+  nothing.
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+_ROOT = b"paddle-tpu-prefix-root"
+
+
+def _chain_hash(parent: bytes, tokens: np.ndarray) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent)
+    h.update(np.ascontiguousarray(tokens, dtype=np.int64).tobytes())
+    return h.digest()
+
+
+@dataclass
+class _Entry:
+    block_id: int
+    parent: Optional[bytes]
+    children: int = 0
+    depth: int = 0                      # chain position (0 = first block)
+    hits: int = field(default=0)
+
+
+class PrefixCache:
+    """Host-side chained-hash map from prompt blocks to pool block ids."""
+
+    def __init__(self, allocator, block_size: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        # counters (exported via Engine metrics)
+        self.lookups = 0
+        self.hit_blocks_total = 0
+        self.hit_tokens_total = 0
+        self.lookup_tokens_total = 0
+        self.evictions = 0
+        # the allocator reclaims idle cached blocks through this hook
+        allocator.evict_cb = self._evict_for_alloc
+
+    # -- lookup / register -------------------------------------------------
+
+    def _keys_for(self, prompt: np.ndarray, n_blocks: int) -> List[bytes]:
+        bs, keys, parent = self.block_size, [], _ROOT
+        for i in range(n_blocks):
+            parent = _chain_hash(parent, prompt[i * bs:(i + 1) * bs])
+            keys.append(parent)
+        return keys
+
+    def record_lookup(self, prompt_tokens: int, hit_tokens: int) -> None:
+        """Count one logical lookup toward the hit-rate gauges.  The
+        engine calls this only for results it actually USED (and once
+        per request, not per deferral retry), so ``hit_rate`` never
+        credits tokens that were re-prefilled anyway — discarded
+        (over-budget) and raising lookups are recorded as misses."""
+        self.lookups += 1
+        self.lookup_tokens_total += int(prompt_tokens)
+        self.hit_blocks_total += int(hit_tokens) // self.block_size
+        self.hit_tokens_total += int(hit_tokens)
+
+    def lookup(self, prompt: Sequence[int], count: bool = True
+               ) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``prompt``: ``(n_tokens, block_ids)``.
+
+        Walks the hash chain over whole prompt blocks, stopping at the
+        first absent link; capped so at least one prompt token is always
+        left for the tail prefill.  Touches every hit entry (LRU refresh)
+        but takes NO references — the caller refs the blocks it actually
+        admits a sequence onto.  ``count=False`` skips the hit-rate
+        counters — the engine counts via :meth:`record_lookup` instead,
+        after it has decided whether the result is actually used."""
+        prompt = np.asarray(list(prompt), dtype=np.int64).reshape(-1)
+        if count:
+            self.lookups += 1
+            self.lookup_tokens_total += int(prompt.size)
+        max_hit = max(0, (int(prompt.size) - 1) // self.block_size)
+        block_ids: List[int] = []
+        for key in self._keys_for(prompt, max_hit):
+            e = self._entries.get(key)
+            if e is None:
+                break
+            e.hits += 1
+            self._entries.move_to_end(key)
+            block_ids.append(e.block_id)
+        if count:
+            self.hit_blocks_total += len(block_ids)
+            self.hit_tokens_total += len(block_ids) * self.block_size
+        return len(block_ids) * self.block_size, block_ids
+
+    def register(self, prompt: Sequence[int], block_ids: Sequence[int]
+                 ) -> int:
+        """Make ``prompt``'s whole blocks hittable by later requests.
+
+        ``block_ids`` must cover the prompt's full blocks in order (the
+        slot's table prefix).  Blocks already registered under the same
+        chain key are left as-is (first writer wins — the bytes are
+        bitwise-identical by construction); each newly-registered block
+        takes one allocator ref on behalf of the cache.  Returns how many
+        new entries were created."""
+        prompt = np.asarray(list(prompt), dtype=np.int64).reshape(-1)
+        n_full = min(int(prompt.size) // self.block_size, len(block_ids))
+        created, parent = 0, None
+        for depth, key in enumerate(self._keys_for(prompt, n_full)):
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                parent = key
+                continue
+            self._entries[key] = _Entry(
+                block_id=int(block_ids[depth]), parent=parent, depth=depth)
+            self.allocator.ref(int(block_ids[depth]))
+            self.allocator.mark_cached(int(block_ids[depth]))
+            if parent is not None:
+                self._entries[parent].children += 1
+            parent = key
+            created += 1
+        return created
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evictable(self) -> Optional[bytes]:
+        """Oldest leaf entry whose block is idle (cache holds the only
+        ref) — evicting anything else would either break a chain or free
+        nothing."""
+        for key, e in self._entries.items():
+            if e.children == 0 and self.allocator.refcount(e.block_id) == 1:
+                return key
+        return None
+
+    def _evict_one(self, key: bytes) -> None:
+        e = self._entries.pop(key)
+        if e.parent is not None and e.parent in self._entries:
+            self._entries[e.parent].children -= 1
+        self.allocator.unmark_cached(e.block_id)
+        self.allocator.unref(e.block_id)
+        self.evictions += 1
+
+    def _evict_for_alloc(self, n_blocks: int) -> int:
+        """Allocator pressure hook: free up to ``n_blocks`` idle cached
+        blocks, LRU leaf-first.  Returns how many were freed."""
+        freed = 0
+        while freed < n_blocks:
+            key = self._evictable()
+            if key is None:
+                break
+            self._evict_one(key)
+            freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every entry (releasing the cache's refs).  Returns the
+        number of entries dropped."""
+        n = 0
+        while self._entries:
+            key = self._evictable()
+            if key is None:
+                # remaining entries are pinned by live slots: drop the
+                # cache's view of them anyway (refs released, chains gone)
+                key = next(iter(self._entries))
+            self._evict_one(key)
+            n += 1
+        return n
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def hit_rate(self) -> float:
+        """Fraction of looked-up prompt tokens served from cache."""
+        return self.hit_tokens_total / self.lookup_tokens_total \
+            if self.lookup_tokens_total else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "lookups": self.lookups,
+            "hit_blocks": self.hit_blocks_total,
+            "hit_tokens": self.hit_tokens_total,
+            "lookup_tokens": self.lookup_tokens_total,
+            "hit_rate": round(self.hit_rate(), 4),
+            "evictions": self.evictions,
+        }

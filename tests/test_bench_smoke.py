@@ -44,6 +44,12 @@ def test_serving_mode_emits_json_line():
               "deadline_expired", "step_retries"):
         assert out[k] == 0, (k, out)
     assert out["engine_state"] == "active"
+    # paged KV + prefix reuse (ISSUE 5): the shared-prefix workload must
+    # actually hit the cache, and both layouts report TTFT side by side
+    assert out["serving_prefix_hit_rate"] > 0
+    assert out["serving_kv_blocks_in_use"] > 0
+    assert out["ttft_ms_paged"] > 0 and out["ttft_ms_contiguous"] > 0
+    assert out["paged_engine_state"] == "active"
 
 
 def test_preflight_failure_is_structured():

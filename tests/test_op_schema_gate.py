@@ -105,6 +105,14 @@ JUSTIFIED = {
     "cached_attention": (
         "serving decode kernel over KV-cache state; parity vs the full-"
         "recompute forward is asserted end-to-end in tests/test_serving.py"),
+    "block_prefill_attention": (
+        "paged-serving tail-prefill kernel over block-gathered KV state; "
+        "parity and bitwise prefix-reuse are asserted end-to-end in "
+        "tests/test_paging.py"),
+    "gather_block_kv": (
+        "jnp-level gather-by-block-table helper for the paged KV pool "
+        "(not an apply_op); exercised by every paged decode in "
+        "tests/test_paging.py"),
     "fused_linear_cross_entropy": (
         "enrolled as fused_linear_ce (labels need int sampling)"),
     "max_unpool1d": _COMPOSITE, "max_unpool2d": _COMPOSITE,

@@ -1,0 +1,633 @@
+"""Paged KV cache: block allocator, prefix cache, paged decode parity,
+and the paged serving engine (ISSUE 5).
+
+Correctness tests run the cache paths EAGERLY (milliseconds); the engine
+tests compile the paged tail-bucket prefill + decode programs once and
+assert the executable cache's miss counter stays flat through
+admit/retire churn with prefix reuse.  NOTHING here may be marked slow
+— tools/collect_gate.py enforces that this module always rides in
+tier-1, so the allocator is exercised on every CI run.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fault_tolerance import ServingFaultPlan
+from paddle_tpu.models import (
+    GPTForCausalLM, LlamaForCausalLM, gpt_tiny, llama_tiny,
+)
+from paddle_tpu.serving import (
+    AllocatorError, BlockAllocator, Engine, KVCache, PagedCacheContext,
+    PagedKVCache, PrefixCache,
+)
+from paddle_tpu.serving.kv_cache import CacheContext
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def llama():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    return m
+
+
+def _full_logits(model, seq):
+    with paddle.no_grad():
+        out = model(paddle.to_tensor(np.asarray(seq, np.int64)[None]))
+    return out.numpy()[0]
+
+
+def _assert_greedy_chain(model, prompt, out_ids):
+    L = len(prompt)
+    full = list(prompt) + [int(t) for t in out_ids]
+    logits = _full_logits(model, full[:-1])
+    for i, t in enumerate(out_ids):
+        assert int(np.argmax(logits[L - 1 + i])) == int(t), (i, t)
+
+
+class TestBlockAllocator:
+    def test_alloc_ref_unref_cycle(self):
+        al = BlockAllocator(8, reserved=1)
+        assert al.free_blocks == 7
+        blocks = al.alloc(3)
+        assert len(blocks) == 3 and 0 not in blocks
+        assert all(al.refcount(b) == 1 for b in blocks)
+        al.ref(blocks[0])
+        assert al.refcount(blocks[0]) == 2
+        al.unref(blocks[0])
+        for b in blocks:
+            al.unref(b)
+        assert al.free_blocks == 7
+        assert al.check() == []
+
+    def test_misuse_raises_not_corrupts(self):
+        al = BlockAllocator(4)
+        (b,) = al.alloc(1)
+        al.unref(b)
+        with pytest.raises(AllocatorError, match="double free"):
+            al.unref(b)
+        with pytest.raises(AllocatorError, match="ref of free"):
+            al.ref(b)
+        with pytest.raises(AllocatorError, match="out of pool"):
+            al.refcount(99)
+        with pytest.raises(AllocatorError):
+            al.refcount(0)               # the reserved scratch block
+        assert al.check() == []          # misuse rejected, state intact
+
+    def test_all_or_nothing_and_eviction_hook(self):
+        al = BlockAllocator(5, reserved=1)     # 4 usable
+        held = al.alloc(3)
+        assert al.alloc(2) is None             # short by 1, no evictor
+        assert al.free_blocks == 1             # nothing was popped
+        assert al.alloc_failures == 1
+        # turn held[0] into an idle cached block (cache ref only): the
+        # slot's ref moves to the cache, leaving refcount 1
+        al.ref(held[0])
+        al.mark_cached(held[0])
+        al.unref(held[0])                      # the slot retired
+        calls = []
+
+        def evict(n):
+            calls.append(n)
+            al.unmark_cached(held[0])
+            al.unref(held[0])
+            return 1
+
+        al.evict_cb = evict
+        got = al.alloc(2)                      # 1 free + 1 evicted
+        assert got is not None and len(got) == 2
+        assert calls == [1]
+        assert al.check() == []
+
+    def test_property_random_churn_never_leaks_or_double_frees(self):
+        """Property-style: a random admit/retire/evict interleaving keeps
+        every invariant at every step and ends with the pool whole."""
+        rs = np.random.RandomState(42)
+        al = BlockAllocator(16, reserved=1)
+        cache = PrefixCache(al, block_size=4)
+        live = []                              # lists of slot-held blocks
+        registered = []                        # prompts made hittable
+        for step in range(300):
+            op = rs.randint(4)
+            if op == 0:                        # admit: alloc + maybe hit
+                prompt = rs.randint(0, 50, (rs.randint(4, 17),))
+                hit_tok, hit_blocks = cache.lookup(prompt)
+                fresh = al.alloc(rs.randint(1, 4))
+                if fresh is None:
+                    continue
+                for b in hit_blocks:
+                    al.ref(b)
+                live.append((prompt, list(hit_blocks) + fresh,
+                             len(hit_blocks)))
+            elif op == 1 and live:             # retire (maybe register)
+                idx = rs.randint(len(live))
+                prompt, owned, n_hit = live.pop(idx)
+                if rs.rand() < 0.5:
+                    n_full = prompt.size // 4
+                    if n_full <= len(owned):
+                        cache.register(prompt, owned[:n_full])
+                        registered.append(prompt)
+                for b in owned:
+                    al.unref(b)
+            elif op == 2:                      # eviction pressure
+                cache._evict_for_alloc(rs.randint(1, 3))
+            elif op == 3 and registered:       # lookup of a known prompt
+                cache.lookup(registered[rs.randint(len(registered))])
+            assert al.check() == [], (step, al.check())
+        for _, owned, _ in live:
+            for b in owned:
+                al.unref(b)
+        cache.clear()
+        assert al.check() == []
+        assert al.free_blocks == 15            # the whole pool came back
+        s = al.stats()
+        assert s["used"] == 0 and s["cached"] == 0
+
+
+class TestPrefixCache:
+    def _pair(self, num_blocks=12, bs=4):
+        al = BlockAllocator(num_blocks, reserved=1)
+        return al, PrefixCache(al, block_size=bs)
+
+    def test_chained_lookup_whole_blocks_capped(self):
+        al, pc = self._pair()
+        prompt = list(range(12))               # 3 full blocks of 4
+        blocks = al.alloc(3)
+        pc.register(prompt, blocks)
+        # identical prompt: hits are capped below the full prompt so the
+        # tail prefill always has >= 1 real token
+        n, got = pc.lookup(prompt)
+        assert n == 8 and got == blocks[:2]
+        # longer prompt sharing the prefix: all 3 registered blocks hit
+        n, got = pc.lookup(prompt + [99, 98])
+        assert n == 12 and got == blocks
+        # a mid-chain mismatch stops the walk (hash chaining)
+        n, got = pc.lookup(prompt[:4] + [77, 77, 77, 77] + prompt[8:])
+        assert n == 4 and got == blocks[:1]
+        # shorter than one block: no hit possible
+        assert pc.lookup(prompt[:3]) == (0, [])
+
+    def test_register_dedup_and_lru_leaf_eviction(self):
+        al, pc = self._pair()
+        p1 = list(range(8))
+        b1 = al.alloc(2)
+        assert pc.register(p1, b1) == 2
+        assert pc.register(p1, al.alloc(2)) == 0        # dedup: no-op
+        p2 = p1[:4] + [50, 51, 52, 53]                  # shares block 0
+        b2 = al.alloc(2)
+        assert pc.register(p2, [b1[0], b2[1]]) == 1     # only the leaf
+        # chain: b1[0] has two children (b1[1], b2[1]) — eviction must
+        # take leaves first, LRU order, and never the shared parent
+        for b in b1 + b2:
+            al.unref(b)                                 # slots gone
+        assert pc._evict_for_alloc(1) == 1
+        n, got = pc.lookup(p1 + [9])                    # b1 chain evicted
+        assert (n, got) == (4, [b1[0]])                 # parent survives
+        assert al.check() == []
+
+    def test_eviction_skips_blocks_held_by_live_slots(self):
+        al, pc = self._pair()
+        p = list(range(8))
+        blocks = al.alloc(2)                   # a live slot owns these
+        pc.register(p, blocks)
+        assert pc._evict_for_alloc(2) == 0     # refcount 2: not idle
+        for b in blocks:
+            al.unref(b)                        # slot retires
+        assert pc._evict_for_alloc(2) == 2     # now reclaimable
+        assert al.free_blocks == 11
+        assert al.check() == []
+
+    def test_lookup_touch_refreshes_lru(self):
+        al, pc = self._pair()
+        pa, pb = list(range(8)), list(range(100, 108))
+        ba, bb = al.alloc(2), al.alloc(2)
+        pc.register(pa, ba)
+        pc.register(pb, bb)
+        for b in ba + bb:
+            al.unref(b)
+        pc.lookup(pa + [1])                    # refresh A: B becomes LRU
+        assert pc._evict_for_alloc(2) == 2
+        assert pc.lookup(pa + [1])[0] == 8     # A survived
+        assert pc.lookup(pb + [1])[0] == 0     # B evicted
+
+
+def _paged_generate(model, cfg, kv_heads, prompt, steps, *, slot, cache,
+                    prefix_len=0, shared_blocks=(), bucket=None):
+    """Eager greedy generation through the paged cache paths, returning
+    the logits emitted at every step (tail prefill last-token + decodes)."""
+    L = len(prompt)
+    if bucket is None:
+        bucket = 8 if L - prefix_len <= 8 else 32
+    assert cache.begin_sequence(slot, list(shared_blocks), prefix_len,
+                                bucket)
+    ids = np.zeros((1, bucket), np.int64)
+    ids[0, :L - prefix_len] = prompt[prefix_len:]
+    collected = []
+    with paddle.no_grad():
+        ctx = PagedCacheContext(
+            cache, "prefill", slot=paddle.to_tensor(np.int32(slot)),
+            length=paddle.to_tensor(np.int32(L)),
+            start=paddle.to_tensor(np.int32(prefix_len)))
+        logits = model(paddle.to_tensor(ids), cache_ctx=ctx)
+        cache.set_length(slot, L)
+        collected.append(logits.numpy()[0, L - prefix_len - 1])
+        seq = list(prompt) + [int(np.argmax(collected[-1]))]
+        active = np.zeros((cache.num_slots,), np.int32)
+        active[slot] = 1
+        for _ in range(steps):
+            assert cache.ensure_capacity(slot, len(seq) - 1)
+            toks = np.zeros((cache.num_slots, 1), np.int64)
+            toks[slot, 0] = seq[-1]
+            dctx = PagedCacheContext(cache, "decode",
+                                     active=paddle.to_tensor(active))
+            lg = model(paddle.to_tensor(toks), cache_ctx=dctx)
+            cache.advance(paddle.to_tensor(active))
+            collected.append(lg.numpy()[slot, 0])
+            seq.append(int(np.argmax(collected[-1])))
+    return collected, seq[L:]
+
+
+class TestPagedCacheParity:
+    """Eager parity of the paged paths against full recompute, for GPT
+    and GQA-Llama (ISSUE 5 satellite), plus slot-churn parity for BOTH
+    cache layouts and the copy-on-extend path."""
+
+    def _mk_cache(self, cfg, kv_heads, num_slots=2):
+        return PagedKVCache(num_slots=num_slots,
+                            num_layers=cfg.num_hidden_layers, max_seq=32,
+                            num_kv_heads=kv_heads, head_dim=cfg.head_dim,
+                            block_size=8)
+
+    def _check(self, model, cfg, kv_heads):
+        rs = np.random.RandomState(0)
+        prompt = rs.randint(0, cfg.vocab_size, (7,)).tolist()
+        cache = self._mk_cache(cfg, kv_heads)
+        got, got_ids = _paged_generate(model, cfg, kv_heads, prompt, 5,
+                                       slot=1, cache=cache)
+        L = len(prompt)
+        ref_all = _full_logits(model, (prompt + got_ids)[:-1])
+        for i, step_logits in enumerate(got):
+            np.testing.assert_allclose(step_logits, ref_all[L - 1 + i],
+                                       atol=2e-4, rtol=2e-4)
+        _assert_greedy_chain(model, prompt, got_ids)
+        cache.release_slot(1)
+        assert cache.check_invariants() == []
+
+    def test_gpt_paged_matches_full_recompute(self, gpt):
+        self._check(gpt, gpt.config, gpt.config.num_attention_heads)
+
+    def test_llama_gqa_paged_matches_full_recompute(self, llama):
+        assert llama.config.n_kv_heads < llama.config.num_attention_heads
+        self._check(llama, llama.config, llama.config.n_kv_heads)
+
+    @pytest.mark.parametrize("layout", ["contiguous", "paged"])
+    def test_slot_churn_parity(self, gpt, llama, layout):
+        """Retire then re-admit into the SAME slot: cached decode logits
+        must match the full-recompute reference for GPT and GQA-Llama —
+        stale block/table state from the first tenant must be invisible
+        to the second."""
+        for model in (gpt, llama):
+            cfg = model.config
+            kv_heads = getattr(cfg, "n_kv_heads", None) or \
+                cfg.num_attention_heads
+            rs = np.random.RandomState(7)
+            long_p = rs.randint(0, cfg.vocab_size, (12,)).tolist()
+            short_p = rs.randint(0, cfg.vocab_size, (4,)).tolist()
+            if layout == "paged":
+                cache = self._mk_cache(cfg, kv_heads)
+                for prompt in (long_p, short_p):   # longer tenant first
+                    got, ids = _paged_generate(
+                        model, cfg, kv_heads, prompt, 3, slot=1,
+                        cache=cache, bucket=16)
+                    L = len(prompt)
+                    ref = _full_logits(model, (prompt + ids)[:-1])
+                    for i, sl in enumerate(got):
+                        np.testing.assert_allclose(
+                            sl, ref[L - 1 + i], atol=2e-4, rtol=2e-4)
+                    _assert_greedy_chain(model, prompt, ids)
+                    cache.release_slot(1)          # retire: churn the slot
+                assert cache.check_invariants() == []
+            else:
+                cache = KVCache(num_slots=2,
+                                num_layers=cfg.num_hidden_layers,
+                                max_seq=32, num_kv_heads=kv_heads,
+                                head_dim=cfg.head_dim)
+                for prompt in (long_p, short_p):
+                    L = len(prompt)
+                    ids = np.zeros((1, 16), np.int64)
+                    ids[0, :L] = prompt
+                    with paddle.no_grad():
+                        ctx = CacheContext(
+                            cache, "prefill",
+                            slot=paddle.to_tensor(np.int32(1)),
+                            length=paddle.to_tensor(np.int32(L)))
+                        out = model(paddle.to_tensor(ids), cache_ctx=ctx)
+                        cache.set_length(1, L)
+                        seq = list(prompt) + \
+                            [int(np.argmax(out.numpy()[0, L - 1]))]
+                        act = paddle.to_tensor(np.asarray([0, 1], np.int32))
+                        for _ in range(3):
+                            toks = np.zeros((2, 1), np.int64)
+                            toks[1, 0] = seq[-1]
+                            dctx = CacheContext(cache, "decode", active=act)
+                            lg = model(paddle.to_tensor(toks),
+                                       cache_ctx=dctx)
+                            cache.advance(act)
+                            seq.append(int(np.argmax(lg.numpy()[1, 0])))
+                    _assert_greedy_chain(model, prompt, seq[L:])
+                    cache.reset()                  # retire: churn the slot
+
+    def test_prefix_hit_decode_bitwise_matches_no_reuse(self, gpt):
+        """ISSUE 5 acceptance: with a shared prefix >= 2 blocks, the
+        cached-hit tail prefill + decode logits are BITWISE identical to
+        the no-reuse full-prefill reference (the shared blocks hold the
+        bytes the reference run wrote)."""
+        cfg = gpt.config
+        H = cfg.num_attention_heads
+        rs = np.random.RandomState(5)
+        prompt = rs.randint(0, cfg.vocab_size, (21,)).tolist()
+        # no-reuse reference: fresh cache, full 32-bucket prefill
+        ref_cache = self._mk_cache(cfg, H)
+        ref_outs, ref_ids = _paged_generate(gpt, cfg, H, prompt, 4,
+                                            slot=0, cache=ref_cache)
+        # reuse: prime slot 0, then serve the same prompt from slot 1
+        # with a 2-block (16-token) hit and only the 8-wide tail bucket
+        cache = self._mk_cache(cfg, H)
+        _paged_generate(gpt, cfg, H, prompt, 0, slot=0, cache=cache)
+        shared = cache._slot_blocks[0][:2]
+        hit_outs, hit_ids = _paged_generate(
+            gpt, cfg, H, prompt, 4, slot=1, cache=cache,
+            prefix_len=16, shared_blocks=shared, bucket=8)
+        assert hit_ids == ref_ids
+        for a, b in zip(ref_outs, hit_outs):
+            np.testing.assert_array_equal(a, b)
+        # the shared blocks are refcounted by both tenants
+        assert all(cache.allocator.refcount(b) == 2 for b in shared)
+        cache.release_slot(0)
+        cache.release_slot(1)
+        assert cache.check_invariants() == []
+
+    def test_admission_never_recycles_its_own_hit_blocks(self, gpt):
+        """Under pool pressure, allocating the tail may evict idle cached
+        blocks — but never the hit blocks the lookup just returned (they
+        are pinned before alloc), so a prefix and its tail can never
+        alias the same block."""
+        cfg = gpt.config
+        cache = PagedKVCache(num_slots=2,
+                             num_layers=cfg.num_hidden_layers, max_seq=64,
+                             num_kv_heads=cfg.num_attention_heads,
+                             head_dim=cfg.head_dim, block_size=8,
+                             num_blocks=5)          # 4 usable blocks
+        pc = PrefixCache(cache.allocator, block_size=8)
+        prompt = list(range(16))                    # 2 full blocks
+        blocks = cache.allocator.alloc(2)
+        pc.register(prompt, blocks)
+        for b in blocks:
+            cache.allocator.unref(b)                # idle cached (evictable)
+        n, hits = pc.lookup(prompt + [77] * 8)      # hit both blocks
+        assert (n, hits) == (16, blocks)
+        # tail needs 3 blocks but only 2 are free: eviction pressure —
+        # all-or-nothing refusal, with the hit blocks NOT cannibalized
+        assert cache.begin_sequence(0, hits, 16, 24) is False
+        assert cache._slot_blocks[0] == []
+        assert all(cache.allocator.refcount(b) == 1 for b in hits)
+        assert pc.lookup(prompt + [77] * 8)[0] == 16    # still hittable
+        # a tail that fits (2 blocks) admits fine against the same hits
+        assert cache.begin_sequence(0, hits, 16, 16) is True
+        assert cache._slot_blocks[0][:2] == hits
+        assert len(set(cache._slot_blocks[0])) == 4     # no aliasing
+        cache.release_slot(0)
+        assert cache.check_invariants() == []
+
+    def test_copy_on_extend_preserves_the_shared_block(self, gpt):
+        """Appending into a shared block must copy it first: the other
+        holder's view (and the pool accounting) stays intact."""
+        cfg = gpt.config
+        H = cfg.num_attention_heads
+        cache = self._mk_cache(cfg, H)
+        rs = np.random.RandomState(9)
+        prompt = rs.randint(0, cfg.vocab_size, (6,)).tolist()  # in 1 block
+        _paged_generate(gpt, cfg, H, prompt, 0, slot=0, cache=cache,
+                        bucket=8)
+        # manufacture sharing: slot 1 maps the same first block
+        b0 = cache._slot_blocks[0][0]
+        assert cache.begin_sequence(1, [b0], 8, 8)
+        cache.set_length(1, 8)
+        assert cache.allocator.refcount(b0) == 2
+        before_k = np.asarray(cache.k._value()[b0])
+        # slot 0 keeps decoding into positions 6,7 — INSIDE the shared
+        # block — which must trigger copy-on-extend, not an in-place write
+        assert cache.ensure_capacity(0, 6)
+        assert cache.copy_on_extends == 1
+        new_b = cache._slot_blocks[0][0]
+        assert new_b != b0
+        assert cache.allocator.refcount(b0) == 1       # slot 1 only
+        np.testing.assert_array_equal(
+            np.asarray(cache.k._value()[new_b]), before_k)  # copied bytes
+        # a second extend into the (now private) block copies nothing
+        assert cache.ensure_capacity(0, 7)
+        assert cache.copy_on_extends == 1
+        np.testing.assert_array_equal(
+            np.asarray(cache.k._value()[b0]), before_k)     # untouched
+        cache.release_slot(0)
+        cache.release_slot(1)
+        assert cache.check_invariants() == []
+
+
+class TestPagedEngine:
+    """Compiled paged serving: zero-recompile churn, prefix reuse through
+    the engine, chaos on the prefix lookup, and pool-exhaustion isolation.
+    One engine (two buckets) is shared across tests to bound compiles."""
+
+    @pytest.fixture(scope="class")
+    def pengine(self, gpt):
+        eng = Engine(gpt, num_slots=2, max_seq=16, min_bucket=8,
+                     kv_layout="paged", block_size=8)
+        eng.warmup()
+        return eng
+
+    def test_zero_recompile_churn_and_greedy_parity(self, gpt, pengine):
+        eng = pengine
+        assert eng.buckets == [8, 16]
+        warm = eng.metrics.compile_misses
+        assert warm == len(eng.buckets) + 1
+        rs = np.random.RandomState(1)
+        shared = rs.randint(0, 128, (8,)).tolist()          # 1 full block
+        prompts = [shared + rs.randint(0, 128, (t,)).tolist()
+                   for t in (5, 3, 6)]
+        prompts += [rs.randint(0, 128, (L,)).tolist() for L in (4, 9)]
+        reqs = [eng.add_request(p, max_new_tokens=3) for p in prompts]
+        eng.run()
+        st = eng.stats()
+        # zero steady-state recompiles, by the executable cache's counters
+        assert eng.metrics.compile_misses == warm, st["compile_cache"]
+        for p, r in zip(prompts, reqs):
+            assert r.finished and len(r.output_ids) == 3, (r.state, r.error)
+            _assert_greedy_chain(gpt, p, r.output_ids)
+        # prefix traffic was actually served from cache
+        assert st["paging"]["prefix"]["hit_blocks"] >= 2
+        assert st["paging"]["prefix"]["hit_rate"] > 0
+        assert st["paging"]["blocks"]["used"] == 0          # all retired
+        assert st["health"]["kv_block_invariants"] == "ok"
+        assert st["health"]["kv_blocks"]["free"] > 0
+        assert sorted(eng.free_slots) == [0, 1]
+        json.dumps(st)
+        import paddle_tpu.profiler as profiler
+
+        assert eng.name in profiler.serving_paging()
+
+    def test_repeat_prompt_prefills_only_the_tail_bucket(self, gpt,
+                                                         pengine):
+        """Second identical-prefix request: the prefill runs the SMALL
+        bucket (uncached tail only) and the generated tokens match the
+        first request's exactly."""
+        eng = pengine
+        warm = eng.metrics.compile_misses
+        rs = np.random.RandomState(2)
+        prompt = rs.randint(0, 128, (13,)).tolist()     # 1 block + 5 tail
+        base_buckets = dict(eng.metrics.prefills_by_bucket)
+        r1 = eng.add_request(prompt, max_new_tokens=3)
+        eng.run()
+        assert eng.metrics.prefills_by_bucket[16] == \
+            base_buckets.get(16, 0) + 1                 # cold: full bucket
+        r2 = eng.add_request(prompt, max_new_tokens=3)
+        eng.run()
+        assert eng.metrics.prefills_by_bucket[8] == \
+            base_buckets.get(8, 0) + 1                  # hit: tail bucket
+        assert r2.output_ids == r1.output_ids
+        assert eng.metrics.compile_misses == warm
+        assert eng.stats()["health"]["kv_block_invariants"] == "ok"
+
+    def test_prefix_lookup_chaos_degrades_to_miss(self, gpt, pengine):
+        """ISSUE 5 satellite: a raising or stalling prefix lookup is a
+        cache miss — the request completes (full prefill), the engine
+        stays healthy, and no block leaks."""
+        eng = pengine
+        base_err = eng.metrics.prefix_lookup_errors
+        blocks_before = eng.cache.allocator.stats()
+        rs = np.random.RandomState(3)
+        prompt = rs.randint(0, 128, (11,)).tolist()
+        # raising lookup
+        eng.fault_plan = ServingFaultPlan().add(
+            "serving.prefix_lookup", at_call=1)
+        r1 = eng.add_request(prompt, max_new_tokens=2)
+        eng.run()
+        assert r1.finished
+        _assert_greedy_chain(gpt, prompt, r1.output_ids)
+        assert eng.metrics.prefix_lookup_errors - base_err == 1
+        # stalling lookup past the budget: the (late) result is discarded
+        eng.fault_plan = ServingFaultPlan().add(
+            "serving.prefix_lookup", at_call=1, stall_s=0.05)
+        eng.prefix_lookup_timeout_s = 0.01
+        try:
+            t0 = time.perf_counter()
+            r2 = eng.add_request(prompt, max_new_tokens=2)
+            eng.run()
+            assert time.perf_counter() - t0 >= 0.05     # it really stalled
+        finally:
+            eng.prefix_lookup_timeout_s = 0.25
+            eng.fault_plan = ServingFaultPlan()
+        assert r2.finished and r2.output_ids == r1.output_ids
+        assert eng.metrics.prefix_lookup_errors - base_err == 2
+        st = eng.stats()
+        assert st["health"]["state"] == "active"
+        assert st["health"]["kv_block_invariants"] == "ok"
+        after = eng.cache.allocator.stats()
+        # no block leaked: everything either free or retained by the cache
+        assert after["used"] == 0
+        assert after["free"] + after["cached"] == \
+            blocks_before["free"] + blocks_before["cached"]
+        assert sorted(eng.free_slots) == [0, 1]
+
+    def test_pool_exhaustion_fails_request_not_engine(self, gpt, pengine):
+        """Decode growth with every block spoken for: the starved request
+        fails with a clear error; the engine (and the pool accounting)
+        survive."""
+        eng = pengine
+        al = eng.cache.allocator
+        # strip the pool: hold every free block + evict the prefix cache
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.clear()
+        hostage = al.alloc(al.free_blocks - 1)      # leave exactly 1 block
+        assert hostage is not None
+        try:
+            # prompt fits its 1 remaining block, but growth past position
+            # 8 needs a second block the pool cannot supply
+            r = eng.add_request(list(range(6)), max_new_tokens=8)
+            eng.run()
+            assert r.state == "failed"
+            assert "KV block pool exhausted" in r.error
+            assert sorted(eng.free_slots) == [0, 1]
+        finally:
+            for b in hostage:
+                al.unref(b)
+        # engine still fully serviceable
+        r2 = eng.add_request(list(range(6)), max_new_tokens=2)
+        eng.run()
+        assert r2.finished
+        st = eng.stats()
+        assert st["health"]["state"] == "active"
+        assert st["health"]["kv_block_invariants"] == "ok"
+
+    def test_partial_hit_never_overflows_the_block_table(self, gpt):
+        """A partial prefix hit whose padded tail bucket would exceed the
+        slot's table (1 hit + bucket 32 = 5 blocks on a 4-block table)
+        must shrink the hit, not blow up admission.  Runs the engine
+        EAGERLY (to_static disabled) so no extra programs compile."""
+        eng = Engine(gpt, num_slots=1, max_seq=32, min_bucket=8,
+                     kv_layout="paged", block_size=8)
+        paddle.jit.enable_to_static(False)
+        try:
+            base = list(range(32))
+            # 12-token prompt registers exactly its one full block
+            r1 = eng.add_request(base[:8] + [77] * 4, max_new_tokens=1)
+            eng.run()
+            assert r1.finished
+            # 32-token prompt sharing that block: naive hit needs
+            # 1 + bucket_for(24)/8 = 5 > 4 blocks — the hit is dropped
+            r2 = eng.add_request(base, max_new_tokens=1)
+            eng.run()
+            assert r2.finished, (r2.state, r2.error)
+            _assert_greedy_chain(gpt, base, r2.output_ids)
+        finally:
+            paddle.jit.enable_to_static(True)
+        assert eng.cache.check_invariants() == []
+
+    def test_validation_rejects_impossible_prompts(self, gpt):
+        eng = Engine(gpt, num_slots=1, max_seq=16, min_bucket=8,
+                     kv_layout="paged", block_size=8, num_kv_blocks=2)
+        # bucket_for(9..16) = 16 → 2 blocks, but only 1 usable block
+        with pytest.raises(ValueError, match="KV blocks"):
+            eng.add_request(list(range(12)))
+        with pytest.raises(ValueError, match="block_size"):
+            Engine(gpt, max_seq=16, min_bucket=4, kv_layout="paged",
+                   block_size=8)
+        with pytest.raises(ValueError, match="kv_layout"):
+            Engine(gpt, max_seq=16, kv_layout="bogus")
+
+    def test_health_flips_unhealthy_on_invariant_violation(self, gpt):
+        """Allocator corruption is surfaced sticky via health(), never
+        silent (ISSUE 5 satellite)."""
+        eng = Engine(gpt, num_slots=1, max_seq=16, min_bucket=16,
+                     kv_layout="paged", block_size=8)
+        eng.cache.allocator._ref[2] = -1            # simulate corruption
+        h = eng.health()
+        assert h["state"] == "unhealthy"
+        assert h["kv_block_invariants"] != "ok"
+        assert "negative refcounts" in h["kv_block_invariants"][0]
+        assert "KV block accounting" in eng._unhealthy_reason
+        from paddle_tpu.serving.engine import EngineStopped
+
+        with pytest.raises(EngineStopped):
+            eng.add_request([1, 2])

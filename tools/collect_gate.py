@@ -57,7 +57,54 @@ def main(argv=None) -> int:
               file=sys.stderr)
         print(out[-2000:], file=sys.stderr)
         return 1
+    rc = paging_gate(env, collected_output=out)
+    if rc:
+        return rc
     print(f"collect_gate: OK — {collected} tests collect, 0 errors")
+    return 0
+
+
+def paging_gate(env=None, collected_output=None) -> int:
+    """Tier-1 must always exercise the KV block allocator: assert that
+    tests/test_paging.py collects at least one test and that NONE of its
+    tests is marked ``slow`` (the tier-1 run deselects ``slow``, so a
+    slow mark there would silently drop allocator coverage).
+
+    ``collected_output`` is main()'s own ``--collect-only -q`` listing —
+    reused for the collects-at-all half so the gate adds only ONE extra
+    pytest subprocess (the ``-m slow`` filter, the only new signal)."""
+    if env is None:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def _collect(extra, target="tests/test_paging.py"):
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "--collect-only", "-q",
+             "-p", "no:cacheprovider", *extra, target],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        # "20 tests collected", "5/20 tests collected (15 deselected)",
+        # or "no tests collected (20 deselected)"
+        m = re.search(r"(\d+)(?:/\d+)? tests? collected",
+                      r.stdout + r.stderr)
+        return int(m.group(1)) if m else 0
+
+    if collected_output is not None:
+        total = len(re.findall(r"^tests/test_paging\.py::",
+                               collected_output, flags=re.M))
+    else:
+        total = _collect([])
+    if total == 0:
+        print("collect_gate: FAIL — tests/test_paging.py collects no "
+              "tests (the allocator would go untested)", file=sys.stderr)
+        return 1
+    slow = _collect(["-m", "slow"])
+    if slow:
+        print(f"collect_gate: FAIL — {slow} test(s) in "
+              f"tests/test_paging.py are marked slow; tier-1 deselects "
+              f"them, so the allocator would go untested", file=sys.stderr)
+        return 1
+    print(f"collect_gate: paging OK — {total} allocator tests ride in "
+          f"tier-1, none marked slow")
     return 0
 
 
