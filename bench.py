@@ -206,11 +206,23 @@ def serving_main():
     prompts through the warm contiguous engine and through a paged
     engine with prefix reuse, emitting ``serving_prefix_hit_rate``,
     ``serving_kv_blocks_in_use``, and paged vs contiguous ``ttft_ms``
-    side by side; greedy outputs from the two layouts must agree."""
+    side by side; greedy outputs from the two layouts must agree.
+
+    A fleet failover smoke (ISSUE 6) then serves a batch through a
+    2-replica :class:`Fleet` while a replica-scoped fault plan kills
+    replica 1 mid-decode: supervision ejects it, re-dispatches its
+    requests to the survivor, and rebuilds it — emitting
+    ``serving_fleet_tokens_per_sec`` (aggregate, measured across the
+    chaos), ``serving_fleet_failover_recovery_ms`` (measured
+    eject-to-rejoin wall time), and ``serving_fleet_redispatches``.
+    Every request must reach a terminal state exactly once."""
+    import time as _time
+
     import numpy as np
     import paddle_tpu as paddle
+    from paddle_tpu.distributed.fault_tolerance import ServingFaultPlan
     from paddle_tpu.models import gpt_tiny, GPTForCausalLM
-    from paddle_tpu.serving import Engine
+    from paddle_tpu.serving import Engine, Fleet
 
     paddle.seed(0)
     model = GPTForCausalLM(gpt_tiny())
@@ -261,6 +273,45 @@ def serving_main():
             f"paged shared-prefix workload unhealthy: "
             f"{pst['health']}", metric="serving_gpt_tiny_decode_tokens_per_sec")
 
+    # -- fleet failover smoke: kill 1 of 2 replicas mid-decode -----------
+    plan = ServingFaultPlan().add("serving.r1.decode", at_call=2, times=2)
+    fleet = Fleet(model, num_replicas=2, num_slots=2, max_seq=64,
+                  min_bucket=8, kv_layout="paged", block_size=8,
+                  eject_after_failures=2, max_redispatch=2,
+                  fault_plan=plan)
+    fleet.warmup()
+    f_prompts = [rs.randint(0, 128, (L,)).tolist()
+                 for L in (5, 11, 7, 16, 4, 9)]
+    terminals = []
+    t0 = _time.perf_counter()
+    f_reqs = [fleet.submit(p, max_new_tokens=8,
+                           # pin one stream onto the doomed replica so the
+                           # fault is guaranteed to orphan in-flight work
+                           replica=1 if i == 0 else None,
+                           done_cb=lambda fr: terminals.append(fr.request_id))
+              for i, p in enumerate(f_prompts)]
+    fleet.run()
+    fleet_dt = _time.perf_counter() - t0
+    fst = fleet.stats()
+    sup = fst["supervision"]
+    if sorted(terminals) != sorted(r.request_id for r in f_reqs) or \
+            fst["requests"]["duplicate_terminals"] != 0:
+        fail_structured(
+            f"fleet terminal contract violated: {fst['requests']}",
+            metric="serving_gpt_tiny_decode_tokens_per_sec")
+    if any(not r.finished for r in f_reqs):
+        fail_structured(
+            f"fleet chaos left unfinished requests: "
+            f"{[(r.state, r.error) for r in f_reqs if not r.finished]}",
+            metric="serving_gpt_tiny_decode_tokens_per_sec")
+    if sup["ejections"] != 1 or sup["rebuilds"] != 1 or \
+            fst["dispatch"]["redispatches"] < 1:
+        fail_structured(
+            f"fleet failover did not run as scripted: {sup}, "
+            f"{fst['dispatch']}", metric="serving_gpt_tiny_decode_tokens_per_sec")
+    fleet_tokens = sum(len(r.output_ids) for r in f_reqs)
+    fleet.shutdown(timeout_s=0.0)
+
     def _p50_ttft_ms(reqs):
         ts = sorted(r.ttft_s for r in reqs)
         return round(ts[len(ts) // 2] * 1e3, 3)
@@ -295,6 +346,15 @@ def serving_main():
         "ttft_ms_contiguous": _p50_ttft_ms(c_reqs),
         "paged_copy_on_extends": pst["paging"]["copy_on_extends"],
         "paged_engine_state": pst["health"]["state"],
+        # fleet failover smoke (ISSUE 6): aggregate throughput measured
+        # ACROSS the scripted replica kill (so it prices the failover
+        # in), the measured eject-to-rejoin recovery, and how many
+        # requests had to be replayed onto a survivor
+        "serving_fleet_tokens_per_sec": round(fleet_tokens / fleet_dt, 2),
+        "serving_fleet_failover_recovery_ms": sup["last_recovery_ms"],
+        "serving_fleet_redispatches": fst["dispatch"]["redispatches"],
+        "serving_fleet_affinity_hit_rate":
+            fst["dispatch"]["affinity_hit_rate"],
     }))
 
 

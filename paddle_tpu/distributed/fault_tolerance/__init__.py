@@ -7,14 +7,14 @@ Step-granular auto-resume (`ResilientLoop`), hang detection
 """
 from ..fleet.elastic.manager import ELASTIC_EXIT_CODE
 from .injection import (
-    FaultPlan, ServingFaultPlan, InjectedFault, corrupt_shard,
-    SERVING_FAULT_POINTS,
+    FaultPlan, ServingFaultPlan, ReplicaScopedFaultPlan, InjectedFault,
+    corrupt_shard, SERVING_FAULT_POINTS,
 )
 from .resilient_loop import ResilientLoop, pack_state
 from .watchdog import StepWatchdog, dump_all_stacks
 
 __all__ = [
     "ResilientLoop", "StepWatchdog", "FaultPlan", "ServingFaultPlan",
-    "InjectedFault", "SERVING_FAULT_POINTS", "corrupt_shard",
-    "dump_all_stacks", "ELASTIC_EXIT_CODE", "pack_state",
+    "ReplicaScopedFaultPlan", "InjectedFault", "SERVING_FAULT_POINTS",
+    "corrupt_shard", "dump_all_stacks", "ELASTIC_EXIT_CODE", "pack_state",
 ]

@@ -38,16 +38,28 @@ error isolation) or stalls (exercising the step watchdog):
   call (the watchdog window);
 - specs are comma-separated and each fires exactly over its declared
   call window, so an injected run is reproducible call-for-call.
+
+Replica scoping (the serving *fleet*): unscoped points are global
+call-count keyed — in a multi-replica fleet every replica's decode calls
+advance the same ``serving.decode`` counter, so a plan cannot say "kill
+replica 1, leave the others alone".  A scope prefix fixes that:
+``serving.r<k>.<point>`` (e.g. ``serving.r1.decode@3x2``) fires on the
+3rd-4th decode call *of replica k only*.  Each replica's engine checks
+through a :meth:`ServingFaultPlan.scoped` view that counts the scoped
+key AND the global key per call, so old unscoped specs keep their exact
+fleet-wide global-call semantics while scoped specs target one replica
+deterministically.
 """
 from __future__ import annotations
 
 import os
+import re
 import signal
 import time
 from typing import Optional
 
-__all__ = ["FaultPlan", "ServingFaultPlan", "InjectedFault",
-           "corrupt_shard", "SERVING_FAULT_POINTS"]
+__all__ = ["FaultPlan", "ServingFaultPlan", "ReplicaScopedFaultPlan",
+           "InjectedFault", "corrupt_shard", "SERVING_FAULT_POINTS"]
 
 ENV_DIE_AT_STEP = "PADDLE_TPU_FT_DIE_AT_STEP"
 ENV_DIE_SIGNAL = "PADDLE_TPU_FT_DIE_SIGNAL"
@@ -58,9 +70,20 @@ ENV_SERVING_FAULTS = "PADDLE_TPU_FT_SERVING_FAULTS"
 #: Fault points the serving engine checks (engine.py _step_call/_emit;
 #: ``serving.prefix_lookup`` fires inside the paged engine's host-side
 #: prefix-cache lookup — a raising/stalling lookup must degrade to a
-#: cache miss, never fail the request or leak a block).
+#: cache miss, never fail the request or leak a block).  Any point may
+#: carry a replica scope prefix: ``serving.r<k>.<suffix>``.
 SERVING_FAULT_POINTS = ("serving.prefill", "serving.decode",
                         "serving.stream_cb", "serving.prefix_lookup")
+
+#: ``serving.r<k>.<suffix>`` — a fault point scoped to fleet replica k.
+_SCOPED_POINT_RE = re.compile(r"^serving\.r(\d+)\.(?P<suffix>.+)$")
+
+
+def _canonical_point(point: str) -> str:
+    """Strip a replica scope: ``serving.r2.decode`` → ``serving.decode``
+    (unscoped points pass through)."""
+    m = _SCOPED_POINT_RE.match(point)
+    return f"serving.{m.group('suffix')}" if m else point
 
 
 def _parse_signal(spec: str) -> int:
@@ -133,9 +156,10 @@ class ServingFaultPlan:
 
     def add(self, point: str, at_call: int, times: int = 1,
             stall_s: Optional[float] = None) -> "ServingFaultPlan":
-        if point not in SERVING_FAULT_POINTS:
+        if _canonical_point(point) not in SERVING_FAULT_POINTS:
             raise ValueError(f"unknown serving fault point {point!r}; "
-                             f"want one of {SERVING_FAULT_POINTS}")
+                             f"want one of {SERVING_FAULT_POINTS} "
+                             f"(optionally scoped 'serving.r<k>.<suffix>')")
         if at_call < 1 or times < 1:
             raise ValueError("at_call and times must be >= 1")
         self._rules.append({"point": point, "at": int(at_call),
@@ -173,21 +197,71 @@ class ServingFaultPlan:
         return bool(self._rules)
 
     def calls(self, point: str) -> int:
-        """How many times ``point`` has been checked so far."""
+        """How many times ``point`` has been checked so far (scoped
+        points — ``serving.r<k>.<suffix>`` — count per replica)."""
         return self._calls.get(point, 0)
 
+    def check(self, point: str, scope: Optional[str] = None) -> None:
+        """Count one pass through ``point``; fire any matching rule.
+
+        ``scope`` (e.g. ``"serving.r1"``, supplied by a :meth:`scoped`
+        view) additionally counts the pass under the replica-scoped key
+        ``serving.r1.<suffix>``.  BOTH counters advance before any rule
+        fires, so a firing scoped rule never skews the global call
+        numbering a co-armed unscoped spec keys on.  Scoped rules take
+        precedence when both match the same call."""
+        points = [point]
+        if scope is not None:
+            suffix = _canonical_point(point)[len("serving."):]
+            points.insert(0, f"{scope}.{suffix}")
+        fire, fire_n = None, 0
+        for p in points:
+            n = self._calls.get(p, 0) + 1
+            self._calls[p] = n
+            if fire is None:
+                for r in self._rules:
+                    if r["point"] == p and \
+                            r["at"] <= n < r["at"] + r["times"]:
+                        fire, fire_n = r, n
+                        break
+        if fire is None:
+            return
+        if fire["stall_s"] is not None:
+            time.sleep(fire["stall_s"])
+            return
+        raise InjectedFault(
+            f"injected fault: {fire['point']} call #{fire_n}")
+
+    def scoped(self, replica_index: int) -> "ReplicaScopedFaultPlan":
+        """An engine-facing view of THIS plan scoped to one fleet
+        replica: ``view.check('serving.decode')`` counts both
+        ``serving.r<k>.decode`` (this replica's own counter) and
+        ``serving.decode`` (the fleet-wide counter old unscoped specs
+        key on).  All views share the parent's rules and counters."""
+        return ReplicaScopedFaultPlan(self, replica_index)
+
+
+class ReplicaScopedFaultPlan:
+    """Per-replica view over a shared :class:`ServingFaultPlan` (same
+    ``armed``/``check``/``calls`` surface the engine consumes)."""
+
+    def __init__(self, plan: ServingFaultPlan, replica_index: int):
+        self.plan = plan
+        self.scope = f"serving.r{int(replica_index)}"
+
+    @property
+    def armed(self) -> bool:
+        return self.plan.armed
+
+    def calls(self, point: str) -> int:
+        """Scoped count for canonical points; scoped/foreign keys pass
+        through to the parent untouched."""
+        if _SCOPED_POINT_RE.match(point) or not point.startswith("serving."):
+            return self.plan.calls(point)
+        return self.plan.calls(f"{self.scope}.{point[len('serving.'):]}")
+
     def check(self, point: str) -> None:
-        """Count one pass through ``point``; fire any matching rule."""
-        n = self._calls.get(point, 0) + 1
-        self._calls[point] = n
-        for r in self._rules:
-            if r["point"] != point or not \
-                    (r["at"] <= n < r["at"] + r["times"]):
-                continue
-            if r["stall_s"] is not None:
-                time.sleep(r["stall_s"])
-                return
-            raise InjectedFault(f"injected fault: {point} call #{n}")
+        self.plan.check(point, scope=self.scope)
 
 
 def corrupt_shard(ckpt_path: str, nth: int = 0, flip_at: float = 0.5) -> str:

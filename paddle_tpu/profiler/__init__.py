@@ -91,6 +91,29 @@ def serving_paging() -> dict:
     return out
 
 
+_fleet_metrics: "list" = []
+
+
+def _register_fleet_metrics(m) -> None:
+    _fleet_metrics.append(_weakref.ref(m))
+
+
+def serving_fleet() -> dict:
+    """Supervision snapshot of every live serving fleet, keyed by fleet
+    name: per-replica occupancy/state table, dispatch + prefix-affinity
+    hit rate, ejection/rebuild counters with measured failover recovery
+    time, and request redispatches — see serving.FleetMetrics."""
+    out, live = {}, []
+    for ref in _fleet_metrics:
+        m = ref()
+        if m is None:
+            continue
+        live.append(ref)
+        out[m.name] = m.snapshot()
+    _fleet_metrics[:] = live
+    return out
+
+
 class ProfilerState(enum.Enum):
     """Reference: profiler.py ProfilerState (:34)."""
     CLOSED = 0

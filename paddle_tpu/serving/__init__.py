@@ -19,6 +19,13 @@ deadlines, bounded-queue backpressure (:class:`QueueFull`), bounded step
 retry, watchdog-backed hang detection, and ``drain()`` / ``shutdown()`` /
 ``health()`` lifecycle — see docs/SERVING.md "Failure semantics".
 
+One level up, the fleet degrades per-replica, never per-fleet:
+:class:`Fleet` supervises N engine replicas behind one
+submit/stream/cancel surface — prefix-affinity dispatch, health-driven
+ejection, bounded request re-dispatch (replay-from-prompt with an
+exactly-once terminal contract), and replica rebuild — see
+docs/SERVING.md "Fleet".
+
 See ``docs/SERVING.md`` for the architecture and an end-to-end example.
 """
 from .kv_cache import KVCache, CacheContext  # noqa: F401
@@ -27,13 +34,15 @@ from .paging import (  # noqa: F401
 )
 from .prefix_cache import PrefixCache  # noqa: F401
 from .sampling import SamplingParams, sample  # noqa: F401
-from .metrics import ServingMetrics  # noqa: F401
+from .metrics import ServingMetrics, FleetMetrics  # noqa: F401
 from .engine import (  # noqa: F401
     Engine, Request, QueueFull, EngineStopped,
 )
+from .router import Fleet, FleetRequest  # noqa: F401
 
 __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "SamplingParams", "ServingMetrics", "sample",
            "QueueFull", "EngineStopped",
            "BlockAllocator", "PagedKVCache", "PagedCacheContext",
-           "PrefixCache", "AllocatorError"]
+           "PrefixCache", "AllocatorError",
+           "Fleet", "FleetRequest", "FleetMetrics"]

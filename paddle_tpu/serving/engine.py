@@ -97,6 +97,11 @@ class Request:
     state: str = "queued"
     _defers: int = 0                     # paged admissions deferred so far
     error: Optional[str] = None
+    #: who a failure implicates: ``"request"`` (this request's own prompt,
+    #: callback, sampling, or deadline — retrying elsewhere would fail the
+    #: same way) vs ``"replica"`` (the engine's compiled step / lifecycle
+    #: failed under it — a fleet supervisor may replay it on a survivor)
+    error_kind: str = "request"
     slot: Optional[int] = None
     output_ids: List[int] = field(default_factory=list)
     prefill_bucket: int = 0
@@ -459,11 +464,13 @@ class Engine:
 
     # -- public API --------------------------------------------------------
 
-    @classmethod
-    def from_config(cls, config, **engine_kwargs) -> "Engine":
-        """Predictor-compatible entry: build an Engine from a model config
-        (``GPTConfig``/``LlamaConfig``), a registry name (``"gpt:tiny"``,
-        ``"llama:llama2-7b"``), or a ready model Layer."""
+    @staticmethod
+    def resolve_model(config):
+        """Turn anything ``from_config`` accepts into a model Layer: a
+        ready Layer passes through; a ``GPTConfig``/``LlamaConfig`` or a
+        registry name (``"gpt:tiny"``, ``"llama:llama2-7b"``) builds the
+        model.  Shared with ``serving.router.Fleet``, which builds ONE
+        model and fans it across replicas."""
         from ..nn.layer_base import Layer
         from ..models import (
             GPT_CONFIGS, GPTConfig, GPTForCausalLM,
@@ -471,11 +478,11 @@ class Engine:
         )
 
         if isinstance(config, Layer):
-            return cls(config, **engine_kwargs)
+            return config
         if isinstance(config, GPTConfig):
-            return cls(GPTForCausalLM(config), **engine_kwargs)
+            return GPTForCausalLM(config)
         if isinstance(config, LlamaConfig):
-            return cls(LlamaForCausalLM(config), **engine_kwargs)
+            return LlamaForCausalLM(config)
         if isinstance(config, str):
             family, _, which = config.partition(":")
             reg = {"gpt": (GPT_CONFIGS, GPTForCausalLM),
@@ -486,12 +493,19 @@ class Engine:
                     f"'gpt:<{'|'.join(GPT_CONFIGS)}>' or "
                     f"'llama:<{'|'.join(LLAMA_CONFIGS)}>'")
             cfgs, cls_ = reg
-            return cls(cls_(cfgs[which or "tiny"]()), **engine_kwargs)
+            return cls_(cfgs[which or "tiny"]())
         raise TypeError(
             f"Engine.from_config: unsupported config {type(config).__name__}"
             " — pass a GPTConfig/LlamaConfig, a 'family:size' name, or a "
             "model Layer.  (jit.save artifacts have no cache-aware forward;"
             " serve those through inference.Predictor instead.)")
+
+    @classmethod
+    def from_config(cls, config, **engine_kwargs) -> "Engine":
+        """Predictor-compatible entry: build an Engine from a model config
+        (``GPTConfig``/``LlamaConfig``), a registry name (``"gpt:tiny"``,
+        ``"llama:llama2-7b"``), or a ready model Layer."""
+        return cls(cls.resolve_model(config), **engine_kwargs)
 
     def _validate(self, req: Request) -> Optional[str]:
         """Enqueue-time validation: a malformed request is ``rejected``
@@ -735,7 +749,8 @@ class Engine:
             self._retire(req, "failed",
                          error=f"prefill failed after {n} "
                                f"retr{'y' if n == 1 else 'ies'}: "
-                               f"{type(e).__name__}: {e}")
+                               f"{type(e).__name__}: {e}",
+                         kind="replica")
             return None
 
     def _paged_prefill(self, req: Request, L: int):
@@ -840,15 +855,21 @@ class Engine:
         return False
 
     def _retire(self, req: Request, state: str = "finished",
-                error: Optional[str] = None) -> None:
+                error: Optional[str] = None,
+                kind: Optional[str] = None) -> None:
         """THE single exit path: every terminal transition funnels here,
         so the slot is reclaimed exactly once on every outcome.
-        Idempotent — a request already terminal is left untouched."""
+        Idempotent — a request already terminal is left untouched.
+        ``kind`` tags who the failure implicates (``Request.error_kind``)
+        so a fleet supervisor can tell replayable replica faults from
+        request-fatal ones."""
         if req.done:
             return
         req.state = state
         if error is not None:
             req.error = error
+        if kind is not None:
+            req.error_kind = kind
         req.t_finish = time.perf_counter()
         slot = req.slot
         if slot is not None:
@@ -921,7 +942,7 @@ class Engine:
                    f"retr{'y' if self.max_step_retries == 1 else 'ies'}: "
                    f"{type(e).__name__}: {e}")
             for req in list(self.running.values()):
-                self._retire(req, "failed", error=msg)
+                self._retire(req, "failed", error=msg, kind="replica")
             return
         logits = out.numpy()                     # [slots, V]
         now = time.perf_counter()
@@ -1023,7 +1044,10 @@ class Engine:
             self.state = "draining"
         n = 0
         while (self.running or self.queue) and self.state == "draining":
-            self.step()
+            try:
+                self.step()
+            except EngineStopped:
+                break                    # wedged mid-drain: sticky unhealthy
             n += 1
             if max_steps is not None and n >= max_steps:
                 break
@@ -1043,15 +1067,58 @@ class Engine:
         while (self.running or self.queue) and self.state == "draining":
             if deadline is not None and time.perf_counter() >= deadline:
                 break
-            self.step()
+            try:
+                self.step()
+            except EngineStopped:
+                break                    # wedged mid-drain: cancel the rest
         for req in list(self.queue) + list(self.running.values()):
-            self._retire(req, "cancelled", error="engine shutdown")
+            # lifecycle cancellation implicates the ENGINE, not the
+            # request — a fleet supervisor may replay these elsewhere
+            self._retire(req, "cancelled", error="engine shutdown",
+                         kind="replica")
         self.queue.clear()
         self.metrics.queue_depth = 0
         if self.state != "unhealthy":
             self.state = "stopped"
         self._stop_watchdog()
         return self.stats()
+
+    # -- fleet-supervisor hooks --------------------------------------------
+
+    def export_requests(self) -> List[Request]:
+        """Strip every non-terminal request off this engine for
+        re-dispatch elsewhere — the ejection hook of the fleet
+        supervisor (``serving.router.Fleet``).
+
+        Queued AND in-flight requests are returned in scheduling order
+        (queue first, then running slots) after being retired here as
+        ``cancelled`` with ``error_kind="replica"`` — the single retire
+        path reclaims their slots (and paged blocks) even on an engine
+        mid-corruption, so the exported handles carry no live engine
+        state.  The caller replays each from its original prompt; this
+        engine is then safe to shut down or discard."""
+        out = [r for r in self.queue if not r.done]
+        out.extend(r for r in self.running.values() if not r.done)
+        self.queue.clear()
+        for req in out:
+            self._retire(req, "cancelled",
+                         error=f"exported from engine {self.name!r} "
+                               "on replica ejection",
+                         kind="replica")
+        self.metrics.queue_depth = 0
+        return out
+
+    def prefix_probe(self, prompt_ids: Sequence[int]) -> int:
+        """Longest prompt prefix (in tokens) this engine's prefix cache
+        already holds — side-effect-free (no LRU refresh, no counters,
+        no refs).  0 for the contiguous layout or a disabled/failing
+        cache; the fleet router's affinity signal."""
+        if self.prefix_cache is None:
+            return 0
+        try:
+            return self.prefix_cache.probe(prompt_ids)
+        except Exception:                # noqa: BLE001 — advisory only
+            return 0
 
     def _stop_watchdog(self) -> None:
         """Join and drop the watchdog thread so a drained/stopped engine

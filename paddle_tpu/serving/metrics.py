@@ -8,6 +8,12 @@ and the failure-path counters of the resilience layer (failed/cancelled/
 rejected requests, deadline expiries, callback errors, step failures and
 retries) plus the engine's ``health()`` snapshot.
 
+``FleetMetrics`` is the same idea one level up: per-fleet supervision
+counters (dispatches and affinity hit rate, ejections, rebuilds,
+redispatches, failover recovery time) plus a per-replica occupancy table
+fed by the router — ``profiler.serving_fleet()`` aggregates every live
+fleet.
+
 ``snapshot()`` returns a ``/stats``-style plain dict (JSON-serializable).
 Each ``ServingMetrics`` registers itself with ``paddle_tpu.profiler`` so
 ``profiler.serving_stats()`` aggregates every live engine in the process.
@@ -16,9 +22,9 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Dict
+from typing import Dict, Optional
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "FleetMetrics"]
 
 # Latency distributions keep a bounded sliding window (a long-running
 # engine must not grow host memory with traffic); the cumulative totals
@@ -176,11 +182,17 @@ class ServingMetrics:
         out["prefix_register_errors"] = self.prefix_register_errors
         return out
 
+    def occupancy(self) -> float:
+        """Mean busy-slot fraction over all samples so far (0.0 before
+        the first step) — shared by ``snapshot()`` and the fleet
+        router's per-replica table."""
+        return self._occupancy_sum / self._occupancy_samples \
+            if self._occupancy_samples else 0.0
+
     def snapshot(self) -> dict:
         """The ``/stats`` endpoint payload: one JSON-ready dict.  Latency
         distributions cover the last ``_LATENCY_WINDOW`` samples."""
-        occ = self._occupancy_sum / self._occupancy_samples \
-            if self._occupancy_samples else 0.0
+        occ = self.occupancy()
         return {
             "name": self.name,
             "uptime_s": round(time.perf_counter() - self.t_start, 3),
@@ -219,4 +231,128 @@ class ServingMetrics:
                 self.prefills_by_bucket.items())),
             "compile_cache": {"hits": self.compile_hits,
                               "misses": self.compile_misses},
+        }
+
+
+class FleetMetrics:
+    """Mutable metric sink for one ``serving.router.Fleet``.
+
+    Counts fleet-level request outcomes (terminal states are recorded
+    here exactly once per request — ``duplicate_terminals`` existing at
+    all is the audit that the exactly-once contract held), dispatch
+    decisions (total / prefix-affinity / operator-pinned), and the
+    supervision loop's actions: ejections, rebuilds (with the measured
+    eject→rejoin recovery time — the failover number the serving bench
+    reports), and request redispatches.
+    """
+
+    def __init__(self, name: str = "fleet", num_replicas: int = 1):
+        self.name = name
+        self.num_replicas = num_replicas
+        self.t_start = time.perf_counter()
+        # request outcomes (fleet-level, exactly once per request)
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.rejected = 0
+        self.duplicate_terminals = 0     # must stay 0: exactly-once audit
+        # dispatch decisions
+        self.dispatches = 0
+        self.affinity_hits = 0
+        self.affinity_hit_tokens = 0
+        self.pinned_dispatches = 0
+        # supervision
+        self.redispatches = 0
+        self.ejections = 0
+        self.rebuilds = 0
+        self.rebuild_failures = 0
+        self.last_recovery_s: Optional[float] = None
+        self.total_recovery_s = 0.0
+        # router-provided per-replica table (occupancy, state, queue)
+        self.replicas_cb = None
+        from .. import profiler as _profiler
+
+        _profiler._register_fleet_metrics(self)
+
+    # -- recording hooks ---------------------------------------------------
+
+    def on_submit(self) -> None:
+        self.submitted += 1
+
+    def on_terminal(self, state: str) -> None:
+        if state == "finished":
+            self.completed += 1
+        elif state == "failed":
+            self.failed += 1
+        elif state == "cancelled":
+            self.cancelled += 1
+        elif state == "rejected":
+            self.rejected += 1
+
+    def on_duplicate_terminal(self) -> None:
+        self.duplicate_terminals += 1
+
+    def on_dispatch(self, affinity_tokens: int = 0,
+                    pinned: bool = False) -> None:
+        self.dispatches += 1
+        if pinned:
+            self.pinned_dispatches += 1
+        elif affinity_tokens > 0:
+            self.affinity_hits += 1
+            self.affinity_hit_tokens += affinity_tokens
+
+    def on_redispatch(self) -> None:
+        self.redispatches += 1
+
+    def on_eject(self) -> None:
+        self.ejections += 1
+
+    def on_rebuild(self, recovery_s: float, ok: bool = True) -> None:
+        if ok:
+            self.rebuilds += 1
+            self.last_recovery_s = recovery_s
+            self.total_recovery_s += recovery_s
+        else:
+            self.rebuild_failures += 1
+
+    # -- export ------------------------------------------------------------
+
+    def affinity_hit_rate(self) -> float:
+        """Fraction of ROUTED dispatches (operator pins excluded — they
+        bypass the policy) that landed on a replica already holding a
+        prompt prefix."""
+        routed = self.dispatches - self.pinned_dispatches
+        return self.affinity_hits / routed if routed else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name,
+            "uptime_s": round(time.perf_counter() - self.t_start, 3),
+            "requests": {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "cancelled": self.cancelled,
+                "rejected": self.rejected,
+                "duplicate_terminals": self.duplicate_terminals,
+            },
+            "dispatch": {
+                "total": self.dispatches,
+                "affinity_hits": self.affinity_hits,
+                "affinity_hit_tokens": self.affinity_hit_tokens,
+                "affinity_hit_rate": round(self.affinity_hit_rate(), 4),
+                "pinned": self.pinned_dispatches,
+                "redispatches": self.redispatches,
+            },
+            "supervision": {
+                "ejections": self.ejections,
+                "rebuilds": self.rebuilds,
+                "rebuild_failures": self.rebuild_failures,
+                "last_recovery_ms": None if self.last_recovery_s is None
+                else round(self.last_recovery_s * 1e3, 3),
+                "total_recovery_ms": round(self.total_recovery_s * 1e3, 3),
+            },
+            "replicas": (self.replicas_cb()
+                         if self.replicas_cb is not None else None),
         }

@@ -131,6 +131,22 @@ class PrefixCache:
             self.hit_tokens_total += len(block_ids) * self.block_size
         return len(block_ids) * self.block_size, block_ids
 
+    def probe(self, prompt: Sequence[int]) -> int:
+        """Side-effect-free longest-cached-prefix length in TOKENS: no
+        LRU refresh, no hit/lookup counters, no references taken.  The
+        fleet router's affinity probe — it may interrogate every
+        replica's cache per dispatch, and only the chosen replica's
+        recency order and hit-rate gauges should move (they do, at
+        admission, through the real :meth:`lookup`)."""
+        prompt = np.asarray(list(prompt), dtype=np.int64).reshape(-1)
+        max_hit = max(0, (int(prompt.size) - 1) // self.block_size)
+        n = 0
+        for key in self._keys_for(prompt, max_hit):
+            if key not in self._entries:
+                break
+            n += 1
+        return n * self.block_size
+
     def register(self, prompt: Sequence[int], block_ids: Sequence[int]
                  ) -> int:
         """Make ``prompt``'s whole blocks hittable by later requests.

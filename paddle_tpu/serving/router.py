@@ -1,0 +1,745 @@
+"""Serving fleet supervisor: a replica router over N in-process engines.
+
+PRs 3-5 made one ``Engine`` degrade per-request, never per-engine — but
+the engine itself is still a single point of failure: a wedged compiled
+step or a corrupted block pool flips a sticky ``unhealthy`` flag and
+every queued and in-flight request dies with it.  :class:`Fleet` is the
+next containment ring: it owns N engine **replicas** (each with its own
+KV pool, prefix cache, and compiled executables) behind one
+submit/stream/cancel surface, and treats a replica as a *crashable,
+ejectable, restartable unit*:
+
+- **Dispatch** is prefix-affinity first — a request is routed to the
+  replica whose :class:`~.prefix_cache.PrefixCache` already covers the
+  longest prefix of its prompt (probed side-effect-free via
+  ``Engine.prefix_probe``), so cross-request prefix reuse keeps working
+  fleet-wide — and least-loaded otherwise, with fleet-level admission
+  control aggregating per-replica queue depth.
+- **Supervision**: every ``step()`` polls each replica's ``health()``.
+  A replica that is ``unhealthy`` (watchdog, allocator-invariant
+  violation) or failing consecutively (``eject_after_failures``) is
+  **ejected** from rotation; its queued AND in-flight requests are
+  exported (``Engine.export_requests``) and **re-dispatched** to
+  survivors; the replica is then **rebuilt** (fresh engine over the
+  shared model, re-``warmup()``) and rejoins rotation — the fleet heals
+  without a process restart, and the eject→rejoin time is exported as
+  the measured failover recovery.
+- **Redispatch stream contract**: a re-dispatched request replays from
+  its prompt — its stream restarts from token 0 with
+  ``FleetRequest.redispatched`` / ``.redispatches`` set *before* the
+  first replayed token, its ``output_ids`` are reset, and its terminal
+  state is reached exactly once (fleet-level, audited by the
+  ``duplicate_terminals`` counter).  At most ``max_redispatch`` replays
+  are attempted before the request fails with the ejected replica's
+  recorded error.  Greedy and seeded-sampling replays are
+  deterministic; unseeded temperature sampling redraws (each attempt
+  seeds from its per-replica request id).
+- **Shape discipline**: replicas are ordinary engines, so no failure
+  mode changes a compiled shape on a survivor — ejection, redispatch,
+  and rebuild only move host-side bookkeeping, and the chaos tests
+  assert survivors' executable-cache miss counters stay flat.
+
+Everything is in-process and CPU-testable; the replica boundary is the
+same one the tensor-parallel sharding work (ROADMAP item 1) will land
+on, already fault-tolerant.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .engine import Engine, EngineStopped, QueueFull, Request
+from .metrics import FleetMetrics
+from .sampling import SamplingParams
+
+__all__ = ["Fleet", "FleetRequest"]
+
+_fleet_counter = itertools.count()
+
+#: Fleet-request states a request can never leave.
+FLEET_TERMINAL_STATES = frozenset(
+    {"finished", "failed", "cancelled", "rejected"})
+
+
+@dataclass(eq=False)           # a live handle: identity, not field equality
+class FleetRequest:
+    """One generation request moving through the fleet.
+
+    The fleet-level handle outlives any single replica attempt: the
+    underlying engine :class:`~.engine.Request` is plumbing that may be
+    replayed on a different replica after an ejection, while THIS handle
+    carries the user-visible stream and reaches a terminal state exactly
+    once.  ``output_ids`` mirror the *current* attempt's stream; on
+    redispatch they reset to empty and ``redispatches``/``redispatched``
+    are set before the first replayed token arrives — the stream
+    restarts from token 0, marked.
+    """
+
+    prompt_ids: np.ndarray
+    request_id: int = -1
+    stream_cb: Optional[Callable[[int, "FleetRequest"], None]] = None
+    done_cb: Optional[Callable[["FleetRequest"], None]] = None
+    kwargs: dict = field(default_factory=dict)    # engine add_request kwargs
+
+    # lifecycle (fleet-managed)
+    state: str = "pending"
+    error: Optional[str] = None
+    output_ids: List[int] = field(default_factory=list)
+    redispatches: int = 0
+    redispatched: bool = False
+    #: engine names this request was dispatched to, in order
+    replica_history: List[str] = field(default_factory=list)
+    t_submit: float = 0.0
+    t_finish: Optional[float] = None
+    _attempt: Optional[Request] = field(default=None, repr=False)
+    _cancel: bool = False
+    _fleet: Optional[object] = field(default=None, repr=False)
+
+    @property
+    def finished(self) -> bool:
+        return self.state == "finished"
+
+    @property
+    def done(self) -> bool:
+        return self.state in FLEET_TERMINAL_STATES
+
+    def cancel(self) -> bool:
+        """Stop this request wherever its current attempt lives.
+        Returns False if it is already terminal."""
+        if self.done:
+            return False
+        self._cancel = True
+        fleet = self._fleet() if self._fleet is not None else None
+        if fleet is not None:
+            fleet._on_cancel(self)
+        return True
+
+
+class _Replica:
+    """One supervised engine slot in the fleet rotation."""
+
+    __slots__ = ("index", "engine", "state", "ejections", "rebuilds",
+                 "rebuild_attempts", "last_error", "_eject_t")
+
+    def __init__(self, index: int, engine: Engine):
+        self.index = index
+        self.engine = engine
+        self.state = "active"            # active | ejected | dead
+        self.ejections = 0
+        self.rebuilds = 0
+        self.rebuild_attempts = 0        # consecutive failed rebuilds
+        self.last_error: Optional[str] = None
+        self._eject_t: Optional[float] = None
+
+    def load(self) -> int:
+        return len(self.engine.queue) + len(self.engine.running)
+
+
+class Fleet:
+    """N supervised :class:`~.engine.Engine` replicas behind one
+    submit/stream/cancel surface.
+
+    Args:
+        model_or_config: anything ``Engine.from_config`` accepts (a model
+            Layer, a ``GPTConfig``/``LlamaConfig``, or a registry name).
+            The model is built ONCE and shared across replicas — weights
+            are read-only during serving; each replica owns its own KV
+            storage, prefix cache, and compiled executables.
+        num_replicas: fleet width.
+        max_redispatch: replay budget per request — after this many
+            re-dispatches the request fails with the replica's recorded
+            error.
+        max_queue: fleet-level admission bound on the AGGREGATE queued
+            (not-yet-admitted) depth across active replicas; ``None`` =
+            unbounded.  A full fleet rejects with :class:`QueueFull`.
+        eject_after_failures: eject a replica once its
+            ``consecutive_step_failures`` reaches this (in addition to
+            any replica whose ``health()`` reports ``unhealthy``).
+        supervise_every: run the supervision poll every Nth fleet step
+            (1 = every step).
+        fault_plan: a shared ``ServingFaultPlan``; each replica's engine
+            checks it through a replica-scoped view so
+            ``serving.r<k>.<point>`` specs target exactly one replica
+            (default: the env-armed plan).
+        **engine_kwargs: forwarded to every replica's ``Engine(...)``
+            (``num_slots``, ``max_seq``, ``kv_layout``, ...).  ``name``
+            and ``fault_plan`` are fleet-managed and rejected here.
+    """
+
+    def __init__(self, model_or_config, *, num_replicas: int = 2,
+                 max_redispatch: int = 2, max_queue: Optional[int] = None,
+                 eject_after_failures: int = 2, supervise_every: int = 1,
+                 name: Optional[str] = None, fault_plan=None,
+                 **engine_kwargs):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, "
+                             f"got {num_replicas}")
+        if max_redispatch < 0:
+            raise ValueError("max_redispatch must be >= 0")
+        if eject_after_failures < 1:
+            raise ValueError("eject_after_failures must be >= 1")
+        if supervise_every < 1:
+            raise ValueError("supervise_every must be >= 1")
+        for k in ("name", "fault_plan"):
+            if k in engine_kwargs:
+                raise ValueError(f"{k!r} is fleet-managed; pass it to "
+                                 "Fleet, not through engine kwargs")
+        self.model = Engine.resolve_model(model_or_config)
+        self.name = name or f"fleet-{next(_fleet_counter)}"
+        self.num_replicas = int(num_replicas)
+        self.max_redispatch = int(max_redispatch)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.eject_after_failures = int(eject_after_failures)
+        self.supervise_every = int(supervise_every)
+        self._engine_kwargs = dict(engine_kwargs)
+        if fault_plan is None:
+            from ..distributed.fault_tolerance.injection import \
+                ServingFaultPlan
+
+            fault_plan = ServingFaultPlan.from_env()
+        self.fault_plan = fault_plan
+        self.replicas: List[_Replica] = [
+            _Replica(k, self._make_engine(k))
+            for k in range(self.num_replicas)]
+        self.metrics = FleetMetrics(self.name,
+                                    num_replicas=self.num_replicas)
+        self.metrics.replicas_cb = self._replica_rows
+        self.state = "active"            # active | draining | stopped
+        #: live attempt → (fleet request, replica) — the reap table
+        self._attempts: Dict[Request, Tuple[FleetRequest, _Replica]] = {}
+        #: replica-implicated failures reaped with NO survivor to take
+        #: them — held for redispatch after the supervision pass, which
+        #: may eject and rebuild the implicated replica this very tick
+        self._repatriate: List[Tuple[FleetRequest, str]] = []
+        self._req_counter = itertools.count()
+        self._rr = 0                     # least-loaded tie-break rotation
+        self._tick = 0
+
+    # -- replica construction ----------------------------------------------
+
+    def _make_engine(self, index: int) -> Engine:
+        return Engine(self.model, name=f"{self.name}.r{index}",
+                      fault_plan=self.fault_plan.scoped(index),
+                      **self._engine_kwargs)
+
+    def warmup(self) -> dict:
+        """Warm every replica (pre-compile all buckets + decode per
+        engine) so steady-state serving — and post-failover serving on
+        survivors — triggers zero recompiles."""
+        return {rep.engine.name: rep.engine.warmup()
+                for rep in self.replicas if rep.state == "active"}
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _active(self, exclude: Sequence[_Replica] = ()
+                ) -> List[_Replica]:
+        return [r for r in self.replicas
+                if r.state == "active" and r not in exclude]
+
+    def _choose_replica(self, prompt_ids, exclude: Sequence[_Replica] = ()
+                        ) -> Tuple[Optional[_Replica], int]:
+        """Dispatch policy: the replica whose prefix cache covers the
+        longest prefix of the prompt (ties → least-loaded), else
+        least-loaded (ties → round-robin).  Returns
+        ``(replica, affinity_tokens)``."""
+        cands = self._active(exclude)
+        if not cands:
+            return None, 0
+        probed = [(rep, rep.engine.prefix_probe(prompt_ids))
+                  for rep in cands]
+        best_hit = max(hit for _, hit in probed)
+        if best_hit > 0:
+            tied = [rep for rep, hit in probed if hit == best_hit]
+            return min(tied, key=lambda r: r.load()), best_hit
+        self._rr += 1
+        order = cands[self._rr % len(cands):] + \
+            cands[:self._rr % len(cands)]
+        return min(order, key=lambda r: r.load()), 0
+
+    def _wrap_stream(self, freq: FleetRequest):
+        """Per-attempt stream adapter: mirrors tokens onto the fleet
+        handle and forwards to the user's callback with the FLEET
+        request (so ``redispatches``/``redispatched`` are visible).  A
+        raising user callback propagates into the engine's per-request
+        isolation and fails this request (``error_kind="request"`` — a
+        callback that raises would raise anywhere, so it is never
+        replayed)."""
+        def cb(tok: int, ereq: Request) -> None:
+            entry = self._attempts.get(ereq)
+            if entry is None or entry[0] is not freq:
+                return               # stale attempt from an ejected replica
+            freq.output_ids.append(int(tok))
+            if freq.stream_cb is not None:
+                freq.stream_cb(int(tok), freq)
+        return cb
+
+    def _dispatch(self, freq: FleetRequest,
+                  exclude: Sequence[_Replica] = (),
+                  pin: Optional[int] = None) -> None:
+        """Place ``freq`` on a replica (raises QueueFull/EngineStopped
+        when the fleet genuinely cannot take it; ValueError only from
+        enqueue-time validation, with the fleet handle rejected)."""
+        excluded = list(exclude)
+        while True:
+            if pin is not None:
+                if not (0 <= pin < self.num_replicas):
+                    msg = (f"replica {pin} out of range "
+                           f"[0, {self.num_replicas})")
+                    self._finish(freq, "rejected", error=msg)
+                    err = ValueError(msg)
+                    err.request = freq
+                    raise err
+                rep = self.replicas[pin]
+                if rep.state != "active":
+                    raise EngineStopped(
+                        f"replica {pin} is {rep.state}: cannot pin")
+                affinity = 0
+            else:
+                rep, affinity = self._choose_replica(freq.prompt_ids,
+                                                     excluded)
+                if rep is None:
+                    raise EngineStopped(
+                        f"fleet {self.name!r} has no active replica "
+                        "to dispatch to")
+            try:
+                ereq = rep.engine.add_request(
+                    freq.prompt_ids, stream_cb=self._wrap_stream(freq),
+                    **freq.kwargs)
+            except ValueError as e:
+                # enqueue-time validation: deterministic, final
+                self._finish(freq, "rejected",
+                             error=getattr(e.request, "error", str(e))
+                             if hasattr(e, "request") else str(e))
+                e.request = freq
+                raise
+            except (QueueFull, EngineStopped):
+                # this replica can't take it right now — try another
+                excluded.append(rep)
+                if pin is not None or not self._active(excluded):
+                    raise
+                continue
+            freq._attempt = ereq
+            freq.replica_history.append(rep.engine.name)
+            self._attempts[ereq] = (freq, rep)
+            self.metrics.on_dispatch(affinity_tokens=affinity,
+                                     pinned=pin is not None)
+            return
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int], *,
+               max_new_tokens: int = 16,
+               sampling: Optional[SamplingParams] = None,
+               temperature: Optional[float] = None,
+               eos_token_id: Optional[int] = None,
+               stream_cb: Optional[Callable] = None,
+               done_cb: Optional[Callable] = None,
+               deadline_s: Optional[float] = None,
+               replica: Optional[int] = None) -> FleetRequest:
+        """Enqueue a prompt on the fleet; returns the live
+        :class:`FleetRequest` handle.
+
+        Routing is prefix-affinity first, least-loaded otherwise;
+        ``replica=<k>`` pins the dispatch (an operator/testing escape
+        hatch that bypasses the policy).  A fleet whose aggregate queued
+        depth is at ``max_queue`` raises :class:`QueueFull`; malformed
+        prompts raise ``ValueError`` with the rejected handle on
+        ``.request``.  ``deadline_s`` is a per-ATTEMPT wall-clock budget
+        (it restarts on redispatch — a replay is a fresh prefill)."""
+        if self.state != "active":
+            raise EngineStopped(
+                f"fleet {self.name!r} is {self.state}: not admitting "
+                "new requests")
+        self.metrics.on_submit()
+        prompt = np.asarray(list(prompt_ids), dtype=np.int64).reshape(-1)
+        if sampling is None and temperature is not None:
+            sampling = SamplingParams(temperature=temperature)
+        kwargs = {"max_new_tokens": int(max_new_tokens),
+                  "eos_token_id": eos_token_id,
+                  "deadline_s": deadline_s}
+        if sampling is not None:
+            kwargs["sampling"] = sampling
+        freq = FleetRequest(prompt_ids=prompt,
+                            request_id=next(self._req_counter),
+                            stream_cb=stream_cb, done_cb=done_cb,
+                            kwargs=kwargs)
+        freq.t_submit = time.perf_counter()
+        freq._fleet = weakref.ref(self)
+        if self.max_queue is not None:
+            depth = sum(len(rep.engine.queue) for rep in self._active())
+            if depth >= self.max_queue:
+                msg = (f"fleet queue full: {depth} >= "
+                       f"max_queue={self.max_queue} across "
+                       f"{len(self._active())} active replicas")
+                self._finish(freq, "rejected", error=msg)
+                err = QueueFull(msg, depth)
+                err.request = freq
+                raise err
+        try:
+            self._dispatch(freq, pin=replica)
+        except (QueueFull, EngineStopped) as e:
+            # no replica could take it: the handle must still terminate
+            # (rejected, exactly once) — a submit can never leave a
+            # pending request the fleet no longer tracks
+            if not freq.done:
+                self._finish(freq, "rejected", error=str(e))
+            e.request = freq
+            raise
+        return freq
+
+    def step(self) -> bool:
+        """One fleet tick: step every active replica that has work, reap
+        terminal attempts into fleet outcomes, then run the supervision
+        poll (ejection → export/redispatch → rebuild).  Returns True
+        while any request is in flight."""
+        if self.state == "stopped":
+            raise EngineStopped(f"fleet {self.name!r} is stopped")
+        for rep in list(self.replicas):
+            if rep.state != "active":
+                continue
+            eng = rep.engine
+            if (eng.queue or eng.running) and eng.state in (
+                    "active", "draining"):
+                try:
+                    eng.step()
+                except EngineStopped:
+                    pass                 # unhealthy: supervision ejects it
+            self._reap(rep)
+        self._tick += 1
+        if self._tick % self.supervise_every == 0:
+            self._supervise()
+            # replays parked for lack of a survivor go out only AFTER a
+            # supervision pass — the implicated replica has had its
+            # chance to be ejected and rebuilt before it can be chosen
+            if self._repatriate:
+                batch, self._repatriate = self._repatriate, []
+                for freq, err in batch:
+                    self._redispatch_or_fail(freq, err)
+        return bool(self._attempts or self._repatriate)
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive ``step()`` until every submitted request is terminal
+        (or ``max_steps``)."""
+        n = 0
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+
+    def generate(self, prompts: Sequence[Sequence[int]], *,
+                 max_new_tokens: int = 16, **submit_kwargs
+                 ) -> List[List[int]]:
+        """Synchronous convenience: serve a batch of prompts through the
+        fleet; returns generated ids per prompt."""
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens,
+                            **submit_kwargs) for p in prompts]
+        self.run()
+        return [r.output_ids for r in reqs]
+
+    # -- outcome plumbing --------------------------------------------------
+
+    def _finish(self, freq: FleetRequest, state: str,
+                error: Optional[str] = None) -> None:
+        """THE single fleet-level terminal transition — guarded so every
+        accepted request reaches a terminal state exactly once (a second
+        arrival is counted on ``duplicate_terminals``, never applied)."""
+        if freq.done:
+            self.metrics.on_duplicate_terminal()
+            return
+        freq.state = state
+        if error is not None:
+            freq.error = error
+        freq.t_finish = time.perf_counter()
+        freq._attempt = None
+        self.metrics.on_terminal(state)
+        if freq.done_cb is not None:
+            try:
+                freq.done_cb(freq)
+            except Exception:            # noqa: BLE001 — isolation boundary
+                pass
+
+    def _reap(self, rep: _Replica) -> None:
+        """Map this replica's terminal engine requests onto fleet
+        outcomes: finished/user-cancelled/request-fatal failures are
+        final; replica-implicated failures re-dispatch within budget."""
+        for ereq, (freq, _rep) in list(self._attempts.items()):
+            if _rep is not rep or not ereq.done:
+                continue
+            del self._attempts[ereq]
+            if freq.done:                # late echo of a settled request
+                continue
+            freq._attempt = None
+            if ereq.state == "finished":
+                self._finish(freq, "finished")
+            elif ereq.state == "cancelled":
+                if freq._cancel:
+                    self._finish(freq, "cancelled")
+                elif ereq.error_kind == "replica":
+                    # engine lifecycle cancelled it under the fleet
+                    # (shutdown/export outside the eject path): replay
+                    self._replay(freq, ereq.error, rep)
+                else:
+                    self._finish(freq, "cancelled", error=ereq.error)
+            elif ereq.state == "failed":
+                if ereq.error_kind == "replica":
+                    self._replay(freq, ereq.error, rep)
+                else:
+                    self._finish(freq, "failed", error=ereq.error)
+            else:                        # "rejected" cannot happen here
+                self._finish(freq, ereq.state, error=ereq.error)
+
+    def _replay(self, freq: FleetRequest, error: Optional[str],
+                rep: _Replica) -> None:
+        """Route a reaped replica-implicated failure: to a SURVIVOR when
+        one exists (the implicated replica may still be in rotation,
+        pre-ejection — never replay straight back onto it), else hold
+        it for the post-supervision pass so a single-replica fleet can
+        replay on its own rebuilt engine instead of failing outright."""
+        if self._active((rep,)):
+            self._redispatch_or_fail(freq, error, exclude=(rep,))
+        else:
+            self._repatriate.append((freq, error))
+
+    def _redispatch_or_fail(self, freq: FleetRequest,
+                            error: Optional[str],
+                            exclude: Sequence[_Replica] = ()) -> None:
+        """Replay ``freq`` from its prompt on another replica, within
+        the at-most-``max_redispatch`` budget; over budget it fails with
+        the replica's recorded error.  The stream contract: the marker
+        fields flip and ``output_ids`` reset BEFORE the replay's token 0
+        can arrive."""
+        if freq.done:
+            # settled while parked in _repatriate (user cancel between
+            # steps): the terminal already happened exactly once
+            return
+        if freq._cancel:
+            self._finish(freq, "cancelled")
+            return
+        if freq.redispatches >= self.max_redispatch:
+            self._finish(
+                freq, "failed",
+                error=f"redispatch budget exhausted "
+                      f"({self.max_redispatch}); last replica error: "
+                      f"{error}")
+            return
+        freq.redispatches += 1
+        freq.redispatched = True
+        freq.output_ids = []
+        self.metrics.on_redispatch()
+        try:
+            self._dispatch(freq, exclude=exclude)
+        except (QueueFull, EngineStopped) as e:
+            self._finish(freq, "failed",
+                         error=f"redispatch found no replica: {e}; "
+                               f"original replica error: {error}")
+        except ValueError:
+            # _dispatch already finished it as rejected (cannot really
+            # happen on a replay — the prompt validated once already)
+            pass
+
+    def _on_cancel(self, freq: FleetRequest) -> None:
+        att = freq._attempt
+        if att is not None:
+            att.cancel()                 # reaped as cancelled next step
+        elif not freq.done:
+            self._finish(freq, "cancelled")
+
+    # -- supervision -------------------------------------------------------
+
+    def _supervise(self) -> None:
+        """The robustness core: eject unhealthy/failing replicas (their
+        orphaned requests collected for replay), rebuild every ejected
+        replica, then re-dispatch the orphans onto the healed fleet."""
+        orphans: List[Tuple[FleetRequest, str]] = []
+        for rep in self.replicas:
+            if rep.state != "active":
+                continue
+            h = rep.engine.health()      # also audits paged invariants
+            if h["state"] == "unhealthy":
+                reason = h.get("reason") or "unhealthy"
+            elif h["consecutive_step_failures"] >= \
+                    self.eject_after_failures:
+                reason = (f"{h['consecutive_step_failures']} consecutive "
+                          "compiled-step failures")
+            else:
+                continue
+            orphans.extend(self._eject(rep, reason))
+        for rep in self.replicas:
+            if rep.state == "ejected":
+                self._rebuild(rep)
+        for freq, err in orphans:
+            self._redispatch_or_fail(freq, err)
+
+    def _eject(self, rep: _Replica, reason: str
+               ) -> List[Tuple[FleetRequest, str]]:
+        """Remove a replica from rotation: export its queued + in-flight
+        requests for replay, shut the engine down (joins its watchdog
+        thread; already-exported work cannot leak), and record why."""
+        rep.state = "ejected"
+        rep.ejections += 1
+        rep._eject_t = time.perf_counter()
+        rep.last_error = reason
+        self.metrics.on_eject()
+        err = f"replica {rep.engine.name!r} ejected: {reason}"
+        orphans = []
+        for ereq in rep.engine.export_requests():
+            entry = self._attempts.pop(ereq, None)
+            if entry is None:
+                continue
+            freq = entry[0]
+            freq._attempt = None
+            if not freq.done:
+                orphans.append((freq, err))
+        try:
+            rep.engine.shutdown(timeout_s=0.0)
+        except Exception:                # noqa: BLE001 — already ejected
+            pass
+        return orphans
+
+    #: consecutive failed rebuilds before a replica is marked ``dead``
+    #: and leaves rotation for good — a deterministic rebuild failure
+    #: must not spin warmup forever, but one transient hiccup must not
+    #: permanently shrink the fleet either (each retry rides a later
+    #: supervision pass, one per fleet step).
+    MAX_REBUILD_ATTEMPTS = 3
+
+    def _rebuild(self, rep: _Replica) -> None:
+        """Heal an ejected replica: fresh engine (fresh pool, fresh
+        prefix cache, fresh executables), re-warm, rejoin rotation.  The
+        eject→rejoin wall time is the fleet's measured failover
+        recovery."""
+        try:
+            eng = self._make_engine(rep.index)
+            eng.warmup()
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            rep.rebuild_attempts += 1
+            rep.state = ("dead" if rep.rebuild_attempts >=
+                         self.MAX_REBUILD_ATTEMPTS else "ejected")
+            rep.last_error = (f"rebuild failed "
+                              f"({rep.rebuild_attempts}/"
+                              f"{self.MAX_REBUILD_ATTEMPTS}): "
+                              f"{type(e).__name__}: {e}")
+            self.metrics.on_rebuild(0.0, ok=False)
+            return
+        rep.engine = eng
+        rep.state = "active"
+        rep.rebuilds += 1
+        rep.rebuild_attempts = 0
+        recovery = time.perf_counter() - (rep._eject_t or
+                                          time.perf_counter())
+        rep._eject_t = None
+        self.metrics.on_rebuild(recovery)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, max_steps: Optional[int] = None) -> dict:
+        """Stop admitting, finish every in-flight request (supervision —
+        ejection and rebuild included — keeps running while draining),
+        stop all replicas, and return the final stats snapshot."""
+        if self.state == "active":
+            self.state = "draining"
+        n = 0
+        while (self._attempts or self._repatriate) and \
+                self.state == "draining":
+            self.step()
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        for rep in self.replicas:
+            if rep.state == "active":
+                rep.engine.drain()
+        # the engine drains above may have finished work the step loop
+        # never saw (max_steps cut it short): reap it into fleet
+        # terminals so every done_cb fires and pending reaches 0
+        for rep in self.replicas:
+            if rep.state == "active":
+                self._reap(rep)
+        if not (self._attempts or self._repatriate):
+            self.state = "stopped"
+        return self.stats()
+
+    def shutdown(self, timeout_s: Optional[float] = None) -> dict:
+        """Drain within a wall-clock budget, then cancel whatever is
+        still unfinished and stop every replica."""
+        if self.state == "active":
+            self.state = "draining"
+        deadline = None if timeout_s is None \
+            else time.perf_counter() + float(timeout_s)
+        while (self._attempts or self._repatriate) and \
+                self.state == "draining":
+            if deadline is not None and time.perf_counter() >= deadline:
+                break
+            self.step()
+        for ereq, (freq, _rep) in list(self._attempts.items()):
+            del self._attempts[ereq]
+            self._finish(freq, "cancelled", error="fleet shutdown")
+        for freq, _err in self._repatriate:
+            if not freq.done:
+                self._finish(freq, "cancelled", error="fleet shutdown")
+        self._repatriate.clear()
+        for rep in self.replicas:
+            if rep.state == "active":
+                try:
+                    rep.engine.shutdown(timeout_s=0.0)
+                except Exception:        # noqa: BLE001 — best effort
+                    pass
+        self.state = "stopped"
+        return self.stats()
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Accepted requests not yet terminal."""
+        return len(self._attempts) + len(self._repatriate)
+
+    def _replica_rows(self) -> List[dict]:
+        rows = []
+        for rep in self.replicas:
+            eng = rep.engine
+            m = eng.metrics
+            rows.append({
+                "index": rep.index,
+                "name": eng.name,
+                "state": rep.state,
+                "engine_state": eng.state,
+                "ejections": rep.ejections,
+                "rebuilds": rep.rebuilds,
+                "last_error": rep.last_error,
+                "queue_depth": len(eng.queue),
+                "slots_busy": len(eng.running),
+                "slots_total": eng.num_slots,
+                "occupancy": round(m.occupancy(), 4),
+                "compile_misses": m.compile_misses,
+            })
+        return rows
+
+    def health(self) -> dict:
+        """Fleet liveness probe: fleet state, per-replica health, and
+        in-flight depth — the load-balancer view one level above
+        ``Engine.health()``."""
+        return {
+            "state": self.state,
+            "pending": self.pending,
+            "active_replicas": len(self._active()),
+            "replicas": {rep.engine.name: {
+                "replica_state": rep.state,
+                **rep.engine.health(),
+            } for rep in self.replicas},
+        }
+
+    def stats(self) -> dict:
+        """``/stats``-style snapshot (also exported through
+        ``paddle_tpu.profiler.serving_fleet()``): the fleet metrics plus
+        each replica's full engine snapshot."""
+        out = self.metrics.snapshot()
+        out["state"] = self.state
+        out["pending"] = self.pending
+        out["engines"] = {rep.engine.name: rep.engine.stats()
+                          for rep in self.replicas}
+        return out

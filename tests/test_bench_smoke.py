@@ -50,6 +50,12 @@ def test_serving_mode_emits_json_line():
     assert out["serving_kv_blocks_in_use"] > 0
     assert out["ttft_ms_paged"] > 0 and out["ttft_ms_contiguous"] > 0
     assert out["paged_engine_state"] == "active"
+    # fleet failover smoke (ISSUE 6): the scripted replica kill must have
+    # actually happened (>= 1 redispatch), the fleet must have healed
+    # (measured recovery time), and throughput stays positive across it
+    assert out["serving_fleet_tokens_per_sec"] > 0
+    assert out["serving_fleet_failover_recovery_ms"] > 0
+    assert out["serving_fleet_redispatches"] >= 1
 
 
 def test_preflight_failure_is_structured():

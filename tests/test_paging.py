@@ -631,3 +631,59 @@ class TestPagedEngine:
 
         with pytest.raises(EngineStopped):
             eng.add_request([1, 2])
+
+
+class TestShutdownReleasesPinnedBlocks:
+    """ISSUE 6 satellite: ``Engine.shutdown()`` while a request holds
+    prefix-cache-pinned blocks must release every slot refcount —
+    allocator ``check()`` clean after shutdown.  (Cached blocks staying
+    at refcount 1 is by design: that ref belongs to the prefix cache,
+    not to any slot, and dies with the engine.)"""
+
+    def _engine_with_pinned_request(self, gpt):
+        """A paged engine with one finished request populating the
+        prefix cache and a second mid-decode whose admission PINNED the
+        cached block (refcount 2: cache + slot)."""
+        eng = Engine(gpt, num_slots=2, max_seq=16, min_bucket=8,
+                     kv_layout="paged", block_size=8)
+        eng.warmup()
+        rs = np.random.RandomState(5)
+        shared = rs.randint(0, 128, (8,)).tolist()      # 1 whole block
+        r0 = eng.add_request(shared + [1, 2, 3], max_new_tokens=2)
+        eng.run()
+        assert r0.finished
+        req = eng.add_request(shared + [4, 5], max_new_tokens=32)
+        eng.step()                       # admitted: prefix hit, mid-decode
+        assert not req.done
+        snap = eng._paging_snapshot()
+        assert snap["prefix"]["hit_blocks"] >= 1
+        assert snap["blocks"]["used"] >= 2              # pinned hit + tail
+        return eng, req
+
+    def test_shutdown_mid_decode_releases_every_slot_ref(self, gpt):
+        eng, req = self._engine_with_pinned_request(gpt)
+        eng.shutdown(timeout_s=0.0)      # zero budget: cancels in-flight
+        assert req.state == "cancelled" and req.error_kind == "replica"
+        assert eng.cache.allocator.check() == []        # no violations
+        snap = eng._paging_snapshot()
+        assert snap["blocks"]["used"] == 0              # every slot ref gone
+        assert snap["blocks"]["cached"] == 1            # the cache's own ref
+        assert eng.cache.check_invariants() == []
+
+    def test_wedged_engine_shutdown_still_releases(self, gpt):
+        """The regression: a watchdog flip mid-drain used to raise
+        ``EngineStopped`` out of ``drain()``/``shutdown()`` BEFORE the
+        cancel-and-retire pass, stranding the pinned blocks.  Now a
+        wedged drain returns (sticky unhealthy) and shutdown retires
+        everything it finds."""
+        eng, req = self._engine_with_pinned_request(gpt)
+        eng._mark_wedged()               # what the watchdog thread does
+        st = eng.drain()                 # must NOT raise EngineStopped
+        assert eng.state == "unhealthy" and len(eng.running) == 1
+        assert st["health"]["state"] == "unhealthy"
+        eng.shutdown()
+        assert req.state == "cancelled" and req.error_kind == "replica"
+        assert eng.state == "unhealthy"                 # sticky, visible
+        assert eng.cache.allocator.check() == []
+        assert eng._paging_snapshot()["blocks"]["used"] == 0
+        assert eng.cache.check_invariants() == []

@@ -9,15 +9,28 @@ tests is worse than a red one.  This gate runs ``pytest --collect-only``
 and exits nonzero on ANY collection error, so an import break can never
 again zero out the suite unnoticed.
 
+A second failure class this gate covers (ISSUE 6): the tier-1 suite
+runs ~735s of its 870s CI timeout, so ONE test file quietly growing 2x
+pushes the whole suite over and zeroes it out just as surely as an
+import break.  ``tools/tier1_budgets.json`` records a wall-time budget
+for the slowest tier-1 files; a run that sets
+``PADDLE_TPU_TIER1_TIMING_REPORT=<path>`` gets a per-file duration
+report from tests/conftest.py, and ``--timing-report <path>`` here
+fails the gate when any budgeted file exceeds its recorded budget by
+more than 25%.
+
 Usage::
 
     python tools/collect_gate.py [pytest-target ...]   # default: tests/
+    python tools/collect_gate.py --timing-report /tmp/t1_times.json
 
 Exit codes: 0 = everything collects; 1 = collection errors (listed on
-stderr); pytest's own exit code for other failures (usage error etc.).
+stderr) or a busted wall-time budget; pytest's own exit code for other
+failures (usage error etc.).
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 import subprocess
@@ -25,9 +38,22 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+BUDGET_MANIFEST = os.path.join(REPO, "tools", "tier1_budgets.json")
+
 
 def main(argv=None) -> int:
-    targets = list(argv if argv is not None else sys.argv[1:]) or ["tests/"]
+    args = list(argv if argv is not None else sys.argv[1:])
+    report_path = None
+    if "--timing-report" in args:
+        i = args.index("--timing-report")
+        try:
+            report_path = args[i + 1]
+        except IndexError:
+            print("collect_gate: --timing-report needs a path",
+                  file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    targets = args or ["tests/"]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     r = subprocess.run(
@@ -60,24 +86,37 @@ def main(argv=None) -> int:
     rc = paging_gate(env, collected_output=out)
     if rc:
         return rc
+    if report_path is not None:
+        rc = budget_gate(report_path)
+        if rc:
+            return rc
     print(f"collect_gate: OK — {collected} tests collect, 0 errors")
     return 0
 
 
+#: Test files whose coverage must ALWAYS ride in tier-1: collect at
+#: least one test, and carry no ``slow`` marks (tier-1 deselects slow,
+#: so a slow mark here would silently drop the coverage).
+TIER1_CRITICAL = {
+    "tests/test_paging.py": "the KV block allocator",
+    "tests/test_fleet.py": "fleet supervision/failover",
+}
+
+
 def paging_gate(env=None, collected_output=None) -> int:
-    """Tier-1 must always exercise the KV block allocator: assert that
-    tests/test_paging.py collects at least one test and that NONE of its
-    tests is marked ``slow`` (the tier-1 run deselects ``slow``, so a
-    slow mark there would silently drop allocator coverage).
+    """Tier-1 must always exercise the critical serving suites
+    (``TIER1_CRITICAL``): each file collects at least one test and NONE
+    of its tests is marked ``slow``.
 
     ``collected_output`` is main()'s own ``--collect-only -q`` listing —
     reused for the collects-at-all half so the gate adds only ONE extra
-    pytest subprocess (the ``-m slow`` filter, the only new signal)."""
+    pytest subprocess per file (the ``-m slow`` filter, the only new
+    signal)."""
     if env is None:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
 
-    def _collect(extra, target="tests/test_paging.py"):
+    def _collect(extra, target):
         r = subprocess.run(
             [sys.executable, "-m", "pytest", "--collect-only", "-q",
              "-p", "no:cacheprovider", *extra, target],
@@ -88,23 +127,78 @@ def paging_gate(env=None, collected_output=None) -> int:
                       r.stdout + r.stderr)
         return int(m.group(1)) if m else 0
 
-    if collected_output is not None:
-        total = len(re.findall(r"^tests/test_paging\.py::",
-                               collected_output, flags=re.M))
-    else:
-        total = _collect([])
-    if total == 0:
-        print("collect_gate: FAIL — tests/test_paging.py collects no "
-              "tests (the allocator would go untested)", file=sys.stderr)
+    counts = {}
+    for target, what in TIER1_CRITICAL.items():
+        if collected_output is not None:
+            total = len(re.findall(rf"^{re.escape(target)}::",
+                                   collected_output, flags=re.M))
+        else:
+            total = _collect([], target)
+        if total == 0:
+            print(f"collect_gate: FAIL — {target} collects no tests "
+                  f"({what} would go untested)", file=sys.stderr)
+            return 1
+        slow = _collect(["-m", "slow"], target)
+        if slow:
+            print(f"collect_gate: FAIL — {slow} test(s) in {target} are "
+                  f"marked slow; tier-1 deselects them, so {what} would "
+                  f"go untested", file=sys.stderr)
+            return 1
+        counts[target] = total
+    print("collect_gate: tier-1-critical OK — " + ", ".join(
+        f"{n} tests in {t}" for t, n in counts.items()) +
+        "; none marked slow")
+    return 0
+
+
+def budget_gate(report_path: str,
+                manifest_path: str = BUDGET_MANIFEST) -> int:
+    """Tier-1 wall-time budgets: every file recorded in
+    ``tools/tier1_budgets.json`` must stay within ``tolerance`` (default
+    +25%) of its budgeted seconds in the run's per-file timing report
+    (written by tests/conftest.py under
+    ``PADDLE_TPU_TIER1_TIMING_REPORT``).
+
+    A budgeted file MISSING from the report also fails: the manifest
+    names the files that dominate the suite's runtime, and a rename or
+    deletion that silently drops one from measurement would let its
+    successor grow unwatched — re-record the manifest instead."""
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        tolerance = float(manifest.get("tolerance", 0.25))
+        budgets = manifest["budgets"]
+    except (OSError, ValueError, KeyError) as e:
+        print(f"collect_gate: FAIL — cannot read budget manifest "
+              f"{manifest_path}: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
-    slow = _collect(["-m", "slow"])
-    if slow:
-        print(f"collect_gate: FAIL — {slow} test(s) in "
-              f"tests/test_paging.py are marked slow; tier-1 deselects "
-              f"them, so the allocator would go untested", file=sys.stderr)
+    try:
+        with open(report_path) as f:
+            measured = json.load(f)["file_seconds"]
+    except (OSError, ValueError, KeyError) as e:
+        print(f"collect_gate: FAIL — cannot read timing report "
+              f"{report_path}: {e}", file=sys.stderr)
         return 1
-    print(f"collect_gate: paging OK — {total} allocator tests ride in "
-          f"tier-1, none marked slow")
+    over = []
+    for path, budget in sorted(budgets.items()):
+        got = measured.get(path)
+        if got is None:
+            over.append(f"  {path}: budgeted {budget}s but absent from "
+                        "the timing report (renamed/deleted? re-record "
+                        "tools/tier1_budgets.json)")
+        elif got > budget * (1.0 + tolerance):
+            over.append(f"  {path}: {got:.1f}s > budget {budget}s "
+                        f"+{tolerance:.0%} (= {budget * (1 + tolerance):.1f}s)")
+    if over:
+        print(f"collect_gate: FAIL — {len(over)} tier-1 wall-time budget "
+              f"violation(s) (suite runs ~735s of its 870s CI timeout; "
+              f"trim the test or re-record the budget deliberately):",
+              file=sys.stderr)
+        for line in over:
+            print(line, file=sys.stderr)
+        return 1
+    print(f"collect_gate: budgets OK — {len(budgets)} tier-1 files within "
+          f"+{tolerance:.0%} of their recorded wall-time budgets")
     return 0
 
 
