@@ -222,11 +222,16 @@ def serving_main():
     import paddle_tpu as paddle
     from paddle_tpu.distributed.fault_tolerance import ServingFaultPlan
     from paddle_tpu.models import gpt_tiny, GPTForCausalLM
-    from paddle_tpu.serving import Engine, Fleet
+    from paddle_tpu.serving import Engine, Fleet, SyncSanitizer
 
     paddle.seed(0)
     model = GPTForCausalLM(gpt_tiny())
     eng = Engine(model, num_slots=4, max_seq=64, min_bucket=8)
+    # sync-point sanitizer on the measured engine: counts every
+    # framework-level d2h transfer per decode step — the host-sync
+    # baseline ROADMAP item 2 (on-device sampling / Pallas decode
+    # kernel) must drive to zero (docs/ANALYSIS.md)
+    eng.sanitizer = SyncSanitizer()
     eng.warmup()
     rs = np.random.RandomState(0)
     lengths = [5, 13, 21, 34, 9, 17, 48, 3, 27, 11, 40, 6]
@@ -336,6 +341,11 @@ def serving_main():
         "deadline_expired": fl["deadline_expired"],
         "step_retries": fl["step_retries"],
         "engine_state": st["health"]["state"],
+        # per-decode-step device→host transfer count measured by the
+        # sync-point sanitizer (ISSUE 7) — the ROADMAP item-2 "before"
+        # number (currently 1.0: the host-side sampling logits pull)
+        "serving_decode_host_transfers":
+            st["sanitizer"]["per_decode_step"],
         # paged KV + prefix reuse (ISSUE 5): the shared-prefix workload
         # through both layouts — hit rate must be > 0, and the paged
         # TTFT reflects prefilling only the uncached tail bucket

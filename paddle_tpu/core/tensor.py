@@ -114,9 +114,22 @@ class SymbolicDim(int):
 
 _trace_hook = None
 
+#: serving.sanitize.SyncSanitizer's counting window: when non-None,
+#: every host-coercing conversion (numpy/item/tolist/__array__/
+#: __float__/__int__/__bool__) reports itself here before converting.
+#: Installed only inside a sanitizer decode window — None (one pointer
+#: compare per conversion) the rest of the time.
+_sync_hook = None
+
 
 def _active_hook():
     return _trace_hook
+
+
+def _note_sync(t) -> None:
+    h = _sync_hook
+    if h is not None:
+        h(t)
 
 
 class Tensor:
@@ -307,18 +320,22 @@ class Tensor:
     # -- conversion -------------------------------------------------------
 
     def numpy(self) -> np.ndarray:
+        _note_sync(self)
         return np.asarray(self._value())
 
     def item(self, *args):
+        _note_sync(self)
         v = self._value()
         if args:
             return np.asarray(v).item(*args)
         return np.asarray(v).item()
 
     def tolist(self):
+        _note_sync(self)
         return np.asarray(self._value()).tolist()
 
     def __array__(self, dtype=None):
+        _note_sync(self)
         a = np.asarray(self._value())
         return a.astype(dtype) if dtype is not None else a
 
@@ -329,6 +346,7 @@ class Tensor:
         return int(self.item())
 
     def __bool__(self):
+        _note_sync(self)
         return bool(self._value())
 
     def __format__(self, spec):
@@ -336,6 +354,7 @@ class Tensor:
             return str(self)
         v = self._value()
         if v.ndim == 0:
+            _note_sync(self)
             return format(v.item(), spec)
         raise TypeError(
             "format spec on a non-scalar Tensor; call .numpy() first")
@@ -547,6 +566,7 @@ class Tensor:
         if _is_tracer(d):
             body = f"<traced {d.aval}>"
         else:
+            _note_sync(self)
             body = np.array2string(np.asarray(d), precision=6, separator=", ")
         return (
             f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "

@@ -6,7 +6,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, _note_sync
 from ._helpers import as_int_list, nondiff, op, unwrap, wrap
 
 __all__ = [
@@ -175,6 +175,11 @@ def tanh_(x, name=None):
 
 
 def tolist(x):
+    # registered over Tensor.tolist, so it must report the device→host
+    # pull itself — the serving sync sanitizer counts conversions at the
+    # framework surface, and this op shadowing the core method was a
+    # real accounting escape (found by tests/test_tpulint.py)
+    _note_sync(x)
     return np.asarray(unwrap(x)).tolist()
 
 

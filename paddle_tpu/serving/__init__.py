@@ -34,6 +34,7 @@ from .paging import (  # noqa: F401
 )
 from .prefix_cache import PrefixCache  # noqa: F401
 from .sampling import SamplingParams, sample  # noqa: F401
+from .sanitize import SyncSanitizer  # noqa: F401
 from .metrics import ServingMetrics, FleetMetrics  # noqa: F401
 from .engine import (  # noqa: F401
     Engine, Request, QueueFull, EngineStopped,
@@ -45,4 +46,4 @@ __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "QueueFull", "EngineStopped",
            "BlockAllocator", "PagedKVCache", "PagedCacheContext",
            "PrefixCache", "AllocatorError",
-           "Fleet", "FleetRequest", "FleetMetrics"]
+           "Fleet", "FleetRequest", "FleetMetrics", "SyncSanitizer"]

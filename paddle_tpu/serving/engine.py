@@ -39,6 +39,7 @@ import itertools
 import time
 import weakref
 from collections import deque
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -50,6 +51,7 @@ from ..core.tensor import Tensor, to_tensor
 from .kv_cache import KVCache, CacheContext
 from .metrics import ServingMetrics
 from .sampling import SamplingParams, sample
+from .sanitize import SyncSanitizer
 
 __all__ = ["Engine", "Request", "SamplingParams", "QueueFull",
            "EngineStopped"]
@@ -305,6 +307,10 @@ class Engine:
 
             fault_plan = ServingFaultPlan.from_env()
         self.fault_plan = fault_plan
+        # sync-point sanitizer (docs/ANALYSIS.md): PADDLE_TPU_SANITIZE=1
+        # counts+attributes host transfers per decode step, =strict also
+        # forbids d2h inside the compiled step; None = zero overhead
+        self.sanitizer = SyncSanitizer.from_env()
         self.state = "active"    # active | draining | stopped | unhealthy
         self._unhealthy_reason: Optional[str] = None
         self._consecutive_failures = 0
@@ -796,6 +802,7 @@ class Engine:
                 self.metrics.on_prefix_register_error()
         return "ok", last, bucket
 
+    # tpulint: hot-path
     def _admit(self, req: Request) -> Optional[bool]:
         """Prefill ``req`` into its pre-assigned slot.  Never raises for
         request-level problems — a prefill/sampling/callback failure fails
@@ -822,6 +829,7 @@ class Engine:
                 to_tensor(np.int32(L)))
             if last is None:
                 return None
+        # tpulint: disable=host-sync -- per-admission (not per-token) pull: the first token is sampled host-side like every other
         logits = last.numpy()
         now = time.perf_counter()
         self.metrics.prefill_time_s += now - t0
@@ -921,6 +929,17 @@ class Engine:
                                    "(even after prefix-cache eviction)")
 
     def _decode(self) -> None:
+        """One decode step, under the sanitizer's counting window when
+        armed (``PADDLE_TPU_SANITIZE``): every framework-level host
+        coercion inside is counted and attributed to its source line —
+        the measured per-token host-sync baseline ROADMAP item 2 must
+        drive to zero."""
+        san = self.sanitizer
+        with (nullcontext() if san is None else san.decode_window()):
+            self._decode_body()
+
+    # tpulint: hot-path
+    def _decode_body(self) -> None:
         if self.kv_layout == "paged":
             self._prepare_decode_paged()
             if not self.running:
@@ -931,19 +950,34 @@ class Engine:
             toks[slot, 0] = self._last_token[slot]
             active[slot] = 1
         t0 = time.perf_counter()
+        san = self.sanitizer
         try:
-            out = self._step_call("serving.decode", self._decode_fn,
-                                  to_tensor(toks), to_tensor(active))
+            # the compiled step itself must not round-trip to host: the
+            # sanitizer arms jax.transfer_guard_device_to_host around it
+            # (log, or disallow in strict mode — backend-enforced on TPU)
+            with (nullcontext() if san is None else san.compiled_guard()):
+                out = self._step_call("serving.decode", self._decode_fn,
+                                      to_tensor(toks), to_tensor(active))
         except Exception as e:           # noqa: BLE001 — isolation boundary
             # retry budget exhausted: every request in THIS batch is
             # implicated; fail them (reclaiming their slots) and keep the
             # engine alive for queued work
+            # the guard's exact phrasing (jaxlib guard_lib), not a loose
+            # "transfer" substring — ordinary step failures that happen
+            # to mention buffers/transfers must not count as violations
+            if san is not None and "device-to-host transfer" in str(e):
+                san.guard_violations += 1
             msg = (f"decode step failed after {self.max_step_retries} "
                    f"retr{'y' if self.max_step_retries == 1 else 'ies'}: "
                    f"{type(e).__name__}: {e}")
             for req in list(self.running.values()):
                 self._retire(req, "failed", error=msg, kind="replica")
             return
+        if san is not None:
+            san.note_step()             # the compiled step actually ran
+        # the ONE intentional per-step d2h (counted by the sanitizer as
+        # the ROADMAP item-2 baseline): host-side sampling needs logits
+        # tpulint: disable=host-sync -- by design: sampling is host-side until ROADMAP item 2 moves it on-device
         logits = out.numpy()                     # [slots, V]
         now = time.perf_counter()
         self.metrics.on_decode_step(len(self.running), now - t0)
@@ -1197,4 +1231,7 @@ class Engine:
         ``paddle_tpu.profiler.serving_stats()``)."""
         self.metrics._slots_busy = len(self.running)
         self.metrics.queue_depth = len(self.queue)
-        return self.metrics.snapshot()
+        snap = self.metrics.snapshot()
+        if self.sanitizer is not None:
+            snap["sanitizer"] = self.sanitizer.report()
+        return snap

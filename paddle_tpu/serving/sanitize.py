@@ -1,0 +1,171 @@
+"""Sync-point sanitizer: measure (and optionally forbid) device→host
+transfers on the serving decode hot path.
+
+The decode discipline the engine is built around — ONE fixed-shape
+compiled step per token, host work limited to sampling and scheduling —
+is only as real as its measurement.  ``SyncSanitizer`` makes it
+measurable (docs/ANALYSIS.md "Sync-point sanitizer"):
+
+- **counting window**: while a decode step runs, every framework-level
+  host coercion (``Tensor.numpy()/.item()/.tolist()/__array__/
+  __float__/__int__/__bool__``) is counted and attributed to the source
+  line that forced it (the first stack frame outside the tensor/
+  sanitizer plumbing).  This is the measured **per-token host-sync
+  baseline** that the ROADMAP item-2 work (Pallas decode kernel +
+  on-device sampling) must drive to zero — exported as
+  ``stats()["sanitizer"]`` and as ``serving_decode_host_transfers`` on
+  ``bench.py --serving``.
+- **compiled guard**: the compiled decode call itself is additionally
+  wrapped in ``jax.transfer_guard_device_to_host`` — ``"log"`` by
+  default, ``"disallow"`` in strict mode — asserting the *compiled*
+  step performs no host round-trip at the runtime level (the guard is
+  enforced by the backend on TPU; on the CPU backend host and device
+  share memory, so the framework-level counting window is the
+  CPU-verifiable surface and the guard is armed but vacuous).
+
+Arming: ``PADDLE_TPU_SANITIZE=1`` (count + log) or
+``PADDLE_TPU_SANITIZE=strict`` (count + disallow: a d2h transfer inside
+the compiled decode step raises, failing the implicated batch loudly)
+arms every Engine at construction via :meth:`SyncSanitizer.from_env`;
+tests and the bench attach one explicitly (``engine.sanitizer =
+SyncSanitizer()``).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from contextlib import contextmanager, nullcontext
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["SyncSanitizer"]
+
+#: files whose frames are plumbing, not an attributable sync site
+_PLUMBING = (os.sep + "core" + os.sep + "tensor.py",
+             os.sep + "serving" + os.sep + "sanitize.py")
+
+#: the conversion surface itself is plumbing wherever it lives — the
+#: attributable site is whoever CALLED the coercion (ops/misc.py's
+#: ``tolist`` op shadows the core method, so file matching alone would
+#: blame the op function for its caller's pull)
+_CONVERSION_FNS = frozenset({
+    "numpy", "item", "tolist", "__array__", "__bool__", "__float__",
+    "__int__", "__format__", "__repr__", "__str__"})
+
+
+def _attribute_site(skip: int = 2) -> str:
+    """``file:line`` of the nearest caller outside the tensor/sanitizer
+    plumbing, path shortened to the repo-relative tail."""
+    f = sys._getframe(skip)
+    while f is not None:
+        fname = f.f_code.co_filename
+        if not fname.endswith(_PLUMBING) \
+                and f.f_code.co_name not in _CONVERSION_FNS:
+            parts = fname.split(os.sep)
+            for anchor in ("paddle_tpu", "tests", "tools"):
+                if anchor in parts:
+                    fname = os.sep.join(parts[parts.index(anchor):])
+                    break
+            else:
+                fname = os.sep.join(parts[-2:])
+            return f"{fname}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class SyncSanitizer:
+    """Per-engine host-transfer meter for steady-state decode.
+
+    One instance is owned by one Engine (single-threaded scheduler —
+    the counting hook is installed only inside ``decode_window``, so
+    concurrent engines never see each other's windows).
+    """
+
+    def __init__(self, strict: bool = False):
+        self.strict = bool(strict)
+        self.decode_steps = 0
+        self.host_transfers = 0
+        self.by_site: Dict[str, int] = {}
+        self.guard_violations = 0
+        self._in_window = False
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_env(cls) -> Optional["SyncSanitizer"]:
+        """The env-armed sanitizer (``PADDLE_TPU_SANITIZE=1|strict``),
+        or None when the mode is off (the default: zero overhead)."""
+        v = os.environ.get("PADDLE_TPU_SANITIZE", "").strip().lower()
+        if v in ("", "0", "false", "off", "no"):
+            return None
+        if v in ("1", "true", "on", "yes"):
+            return cls(strict=False)
+        if v == "strict":
+            return cls(strict=True)
+        raise ValueError(
+            f"PADDLE_TPU_SANITIZE={v!r}: expected 1 (count+log), "
+            "strict (count+disallow), or 0/off to disable")
+
+    # -- the two measurement surfaces --------------------------------------
+
+    def _on_sync(self, _tensor) -> None:
+        self.host_transfers += 1
+        site = _attribute_site()
+        self.by_site[site] = self.by_site.get(site, 0) + 1
+
+    def note_step(self) -> None:
+        """One compiled decode step actually executed.  Called by the
+        engine after a successful step call — NOT by ``decode_window``,
+        so windows that abort before the compiled call (paged pool
+        exhaustion retiring every request, retry budget exhausted) never
+        dilute ``per_decode_step`` below the real baseline."""
+        self.decode_steps += 1
+
+    @contextmanager
+    def decode_window(self):
+        """Count + attribute every framework-level host coercion during
+        one decode step.  Reentrancy-safe (inner windows don't
+        reinstall the hook); steps are counted by ``note_step``, not by
+        window entry."""
+        from ..core import tensor as tensor_mod
+
+        if self._in_window:
+            yield
+            return
+        self._in_window = True
+        prev = tensor_mod._sync_hook
+        tensor_mod._sync_hook = self._on_sync
+        try:
+            yield
+        finally:
+            tensor_mod._sync_hook = prev
+            self._in_window = False
+
+    def compiled_guard(self):
+        """Context manager armed around the compiled decode call: the
+        step itself must not transfer device→host.  ``"log"`` surfaces
+        violations on stderr; strict mode raises (the engine's error
+        isolation then fails the implicated batch — loud by design)."""
+        guard = getattr(jax, "transfer_guard_device_to_host", None)
+        if guard is None:                # ancient jax: counting only
+            return nullcontext()
+        return guard("disallow" if self.strict else "log")
+
+    # -- export ------------------------------------------------------------
+
+    def per_decode_step(self) -> float:
+        return (self.host_transfers / self.decode_steps
+                if self.decode_steps else 0.0)
+
+    def report(self, top: int = 10) -> dict:
+        """JSON-ready snapshot (``stats()["sanitizer"]``)."""
+        sites = sorted(self.by_site.items(), key=lambda kv: (-kv[1], kv[0]))
+        return {
+            "strict": self.strict,
+            "decode_steps": self.decode_steps,
+            "host_transfers": self.host_transfers,
+            "per_decode_step": round(self.per_decode_step(), 3),
+            "by_site": dict(sites[:top]),
+            "guard_violations": self.guard_violations,
+        }

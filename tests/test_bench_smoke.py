@@ -44,6 +44,11 @@ def test_serving_mode_emits_json_line():
               "deadline_expired", "step_retries"):
         assert out[k] == 0, (k, out)
     assert out["engine_state"] == "active"
+    # sync-point sanitizer baseline (ISSUE 7): exactly ONE device→host
+    # transfer per decode step — the suppressed host-side sampling
+    # logits pull.  ROADMAP item 2 drives this to 0; any OTHER value
+    # means a sync crept into (or silently left) the decode hot path
+    assert out["serving_decode_host_transfers"] == 1.0, out
     # paged KV + prefix reuse (ISSUE 5): the shared-prefix workload must
     # actually hit the cache, and both layouts report TTFT side by side
     assert out["serving_prefix_hit_rate"] > 0

@@ -19,14 +19,25 @@ report from tests/conftest.py, and ``--timing-report <path>`` here
 fails the gate when any budgeted file exceeds its recorded budget by
 more than 25%.
 
+A third failure class (ISSUE 7): the serving stack's zero-recompile and
+no-host-round-trip invariants are now *statically* checkable.
+``--lint`` runs ``python -m tools.tpulint paddle_tpu/`` (the
+recompile-hazard/host-sync AST lint — every suppression must carry a
+reason) and ``python -m tools.tpulint.shape_closure`` (regenerates the
+serving executable-cache key manifest and diffs it against the
+committed ``tools/shape_manifest.json``, so an unexpected new compile
+key fails the gate instead of surfacing as a steady-state cache miss).
+
 Usage::
 
     python tools/collect_gate.py [pytest-target ...]   # default: tests/
     python tools/collect_gate.py --timing-report /tmp/t1_times.json
+    python tools/collect_gate.py --lint
 
 Exit codes: 0 = everything collects; 1 = collection errors (listed on
-stderr) or a busted wall-time budget; pytest's own exit code for other
-failures (usage error etc.).
+stderr), a busted wall-time budget, an active lint finding, or shape-
+manifest drift; pytest's own exit code for other failures (usage error
+etc.).
 """
 from __future__ import annotations
 
@@ -43,6 +54,9 @@ BUDGET_MANIFEST = os.path.join(REPO, "tools", "tier1_budgets.json")
 
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
+    run_lint = "--lint" in args
+    if run_lint:
+        args.remove("--lint")
     report_path = None
     if "--timing-report" in args:
         i = args.index("--timing-report")
@@ -90,7 +104,36 @@ def main(argv=None) -> int:
         rc = budget_gate(report_path)
         if rc:
             return rc
+    if run_lint:
+        rc = lint_gate(env)
+        if rc:
+            return rc
     print(f"collect_gate: OK — {collected} tests collect, 0 errors")
+    return 0
+
+
+def lint_gate(env=None) -> int:
+    """Static-analysis gate (ISSUE 7): tpulint over ``paddle_tpu/``
+    must be clean (suppressions all carry reasons), and the serving
+    shape manifest must match a fresh enumeration of the executable-
+    cache key space (``tools/tpulint/shape_closure.py``)."""
+    if env is None:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    for what, cmd in (
+            ("tpulint", [sys.executable, "-m", "tools.tpulint",
+                         "paddle_tpu/"]),
+            ("shape manifest", [sys.executable, "-m",
+                                "tools.tpulint.shape_closure"])):
+        r = subprocess.run(cmd, cwd=REPO, env=env,
+                           capture_output=True, text=True)
+        if r.returncode:
+            print(f"collect_gate: FAIL — {what} gate "
+                  f"(`{' '.join(cmd[1:])}`):", file=sys.stderr)
+            sys.stderr.write(r.stdout[-3000:] + r.stderr[-3000:])
+            return 1
+        tail = (r.stdout.strip().splitlines() or [""])[-1]
+        print(f"collect_gate: {tail}")
     return 0
 
 

@@ -1,0 +1,36 @@
+"""tpulint — recompile-hazard & host-sync static analysis for paddle_tpu.
+
+Three tools that turn the serving stack's two load-bearing runtime
+invariants — the zero-steady-state-recompile contract and the
+no-host-round-trip decode discipline — into *static* checks that fail a
+PR instead of a production bench (docs/ANALYSIS.md):
+
+1. the **AST lint pass** (`python -m tools.tpulint paddle_tpu/`):
+   an extensible rule registry over every jit-compiled function in the
+   tree, flagging the constructs that silently add an XLA compile key or
+   force a device→host sync (`tools/tpulint/rules.py`);
+2. the **shape-closure analyzer** (`tools/tpulint/shape_closure.py`):
+   enumerates the serving engine's compiled-program key space from
+   config, traces each entry with ``jax.eval_shape`` (no XLA compiles),
+   and proves the executable-cache key set is *closed* over every
+   runtime argument instance — the proof artifact is
+   ``tools/shape_manifest.json``, diffed by ``collect_gate.py --lint``;
+3. the **sync-point sanitizer** (``PADDLE_TPU_SANITIZE=1``, runtime —
+   `paddle_tpu/serving/sanitize.py`): arms ``jax.transfer_guard``
+   around steady-state decode and attributes every host transfer to a
+   source line, establishing the measured per-token host-sync baseline.
+
+Suppression contract: every intentional finding is silenced per-line
+with ``# tpulint: disable=<rule> -- <reason>`` and the reason string is
+MANDATORY — a reasonless suppression is itself a finding that cannot be
+suppressed.
+"""
+from __future__ import annotations
+
+from .linter import (  # noqa: F401
+    Finding, LintResult, lint_paths, lint_file, lint_source,
+)
+from .rules import RULES, rule_codes  # noqa: F401
+
+__all__ = ["Finding", "LintResult", "lint_paths", "lint_file",
+           "lint_source", "RULES", "rule_codes"]

@@ -1,0 +1,64 @@
+"""CLI: ``python -m tools.tpulint [paths...]``.
+
+Exit 0 when every finding is suppressed (each suppression carrying a
+reason); exit 1 on any active finding.  ``--format=json`` emits one
+JSON object for tooling; the default format is file:line:col lines a
+terminal (and CI log) can jump to.
+
+Options:
+    --format=text|json   output format (default text)
+    --list-rules         print the rule registry and exit
+    --show-suppressed    also print suppressed findings (with reasons)
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from .linter import lint_paths
+from .rules import RULES
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    fmt = "text"
+    show_suppressed = False
+    paths = []
+    for a in args:
+        if a == "--list-rules":
+            for r in RULES.values():
+                print(f"{r.code}  {r.name:20s} [{r.scope}]  {r.summary}")
+            return 0
+        if a.startswith("--format="):
+            fmt = a.split("=", 1)[1]
+        elif a == "--show-suppressed":
+            show_suppressed = True
+        elif a.startswith("-"):
+            print(f"tpulint: unknown option {a!r}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+    if not paths:
+        paths = ["paddle_tpu/"]
+    res = lint_paths(paths)
+    active, suppressed = res.active, res.suppressed
+    if fmt == "json":
+        print(json.dumps({
+            "files": res.files,
+            "active": [f.to_dict() for f in active],
+            "suppressed": [f.to_dict() for f in suppressed],
+        }, indent=1))
+        return 1 if active else 0
+    for f in active:
+        print(f.format())
+    if show_suppressed:
+        for f in suppressed:
+            print(f.format())
+    print(f"tpulint: {res.files} files, {len(active)} finding(s), "
+          f"{len(suppressed)} suppressed"
+          + ("" if active else " — clean"))
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

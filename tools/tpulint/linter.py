@@ -1,0 +1,600 @@
+"""tpulint core: file walking, jit-function discovery, taint analysis,
+suppression handling.  The rules themselves live in ``rules.py``.
+
+What counts as a *jit-compiled function* (the lint scope for the
+recompile-hazard rules) is decided statically, per module:
+
+- a function decorated with ``to_static`` / ``jit.to_static`` /
+  ``paddle.jit.to_static`` / ``jax.jit`` (or ``functools.partial(jax.jit,
+  ...)``), or
+- a function whose NAME is later passed to such a wrapper anywhere in
+  the module (``self._decode_fn = jit_mod.to_static(decode_step)`` marks
+  ``decode_step``).
+
+Inside a jitted function every parameter is a traced value; taint
+propagates forward through assignments (two passes, so loop-carried
+taint converges) with static-metadata reads (``.shape``/``.dtype``/
+``.ndim``/``len()``/``isinstance()``/``type()``) pruned — those are
+concrete under trace and branching on them is exactly how bucketed
+programs are SUPPOSED to specialize.
+
+Host functions opt into the host-sync rule with a ``# tpulint:
+hot-path`` marker on (or directly above) their ``def`` line — the
+serving engine's per-token decode loop is the motivating case.
+
+Suppressions are per-line: ``# tpulint: disable=rule[,rule2] --
+reason``.  The reason is mandatory; a reasonless suppression is
+reported as a ``bad-suppression`` finding that cannot itself be
+suppressed.  A suppression comment may sit on the offending line or
+alone on the line directly above it (for lines that would overflow).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: rule name every suppression problem is reported under; never
+#: suppressable (a suppression that silences the suppression police is
+#: how lint rot starts).
+BAD_SUPPRESSION = "bad-suppression"
+PARSE_ERROR = "parse-error"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\-]+)\s*(?:--\s*(\S.*))?$")
+_HOTPATH_RE = re.compile(r"#\s*tpulint:\s*hot-path\b")
+
+#: callables whose results are concrete under trace (branching on them
+#: cannot add a compile key beyond the specialization already implied by
+#: the input spec)
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                 "callable", "id", "repr"}
+#: attribute reads that are static metadata on a traced array
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "stop_gradient",
+                 "name"}
+
+#: wrapper dotted-name tails that mark their function argument (or the
+#: decorated function) as jit-compiled
+_JIT_WRAPPER_TAILS = ("to_static", "jax.jit", "declarative")
+
+
+@dataclass
+class Finding:
+    """One lint finding (possibly suppressed)."""
+
+    rule: str                  # registry name, e.g. "traced-branch"
+    code: str                  # registry code, e.g. "TPL101"
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""           # the suppression's reason when suppressed
+
+    def format(self) -> str:
+        tag = f"{self.code}({self.rule})"
+        s = f"{self.path}:{self.line}:{self.col}: {tag} {self.message}"
+        if self.suppressed:
+            s += f"  [suppressed: {self.reason}]"
+        return s
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "code": self.code, "path": self.path,
+                "line": self.line, "col": self.col,
+                "message": self.message, "suppressed": self.suppressed,
+                "reason": self.reason}
+
+
+@dataclass
+class LintResult:
+    """All findings over a lint run, with the active/suppressed split."""
+
+    findings: List[Finding] = field(default_factory=list)
+    files: int = 0
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def extend(self, other: "LintResult") -> None:
+        self.findings.extend(other.findings)
+        self.files += other.files
+
+
+# -- comment scanning --------------------------------------------------------
+
+class _Pragmas:
+    """Per-line suppression and hot-path markers, from the token stream
+    (comments are invisible to ast)."""
+
+    def __init__(self, source: str, path: str):
+        # line -> (frozenset of rule names, reason or None)
+        self.suppress: Dict[int, Tuple[frozenset, Optional[str]]] = {}
+        self.hot_path_lines: Set[int] = set()
+        #: lines whose ONLY content is a comment (suppressions there
+        #: also cover the next line)
+        self.comment_only: Set[int] = set()
+        self.bad: List[Finding] = []
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, SyntaxError):
+            return
+        code_lines: Set[int] = set()
+        for tok in tokens:
+            if tok.type in (tokenize.COMMENT, tokenize.NL,
+                            tokenize.NEWLINE, tokenize.INDENT,
+                            tokenize.DEDENT, tokenize.ENDMARKER):
+                continue
+            for ln in range(tok.start[0], tok.end[0] + 1):
+                code_lines.add(ln)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            line = tok.start[0]
+            if line not in code_lines:
+                self.comment_only.add(line)
+            if _HOTPATH_RE.search(tok.string):
+                self.hot_path_lines.add(line)
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                if "tpulint:" in tok.string and "hot-path" not in tok.string:
+                    self.bad.append(Finding(
+                        BAD_SUPPRESSION, "TPL100", path, line,
+                        tok.start[1],
+                        f"unparseable tpulint pragma: {tok.string.strip()!r}"
+                        " (want '# tpulint: disable=<rule> -- <reason>')"))
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group(1).split(",") if r.strip())
+            reason = (m.group(2) or "").strip() or None
+            if reason is None:
+                self.bad.append(Finding(
+                    BAD_SUPPRESSION, "TPL100", path, line, tok.start[1],
+                    "suppression without a reason: every "
+                    "'# tpulint: disable=...' must carry "
+                    "' -- <why this is intentional>'"))
+                continue            # a reasonless suppression suppresses
+                                    # NOTHING — the finding shows too
+            banned = rules & {BAD_SUPPRESSION, "TPL100"}
+            if banned:
+                self.bad.append(Finding(
+                    BAD_SUPPRESSION, "TPL100", path, line, tok.start[1],
+                    f"'{BAD_SUPPRESSION}' cannot be suppressed"))
+                rules = rules - banned
+            self.suppress[line] = (rules, reason)
+
+    def lookup(self, line: int, rule: str) -> Optional[Tuple[bool, str]]:
+        """(found, reason) for a suppression covering ``line`` — same
+        line first, then a comment-only line directly above."""
+        for ln in (line, line - 1):
+            entry = self.suppress.get(ln)
+            if entry is None:
+                continue
+            if ln == line - 1 and ln not in self.comment_only:
+                continue            # trailing comment of the PREVIOUS stmt
+            rules, reason = entry
+            if rule in rules:
+                return True, (reason or "")
+        return None
+
+    def is_hot_path(self, def_line: int) -> bool:
+        return (def_line in self.hot_path_lines
+                or def_line - 1 in self.hot_path_lines)
+
+
+# -- jit-function discovery --------------------------------------------------
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('jax.jit', 'np.asarray',
+    '' when not a name chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_wrapper(func: ast.AST) -> bool:
+    """Does this callee expression jit-compile its function argument?"""
+    name = _dotted(func)
+    if not name:
+        return False
+    last = name.split(".")[-1]
+    if last in ("to_static", "declarative"):
+        return True
+    # jax.jit / xxx.jit — but not paddle_tpu's `jit` MODULE reference
+    return last == "jit" and name != "jit"
+
+
+def _decorator_marks_jit(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # @to_static(input_spec=...), @functools.partial(jax.jit, ...)
+        if _is_jit_wrapper(dec.func):
+            return True
+        if _dotted(dec.func).split(".")[-1] == "partial" and dec.args:
+            return _is_jit_wrapper(dec.args[0])
+        return False
+    return _is_jit_wrapper(dec)
+
+
+class _JitIndex(ast.NodeVisitor):
+    """Collect (a) every FunctionDef with its enclosing function scope,
+    (b) the (scope, name) pairs passed to a jit wrapper, (c)
+    module-level mutable bindings (for the mutable-global rule).
+
+    Wrapped-name matching is scope-aware: ``jitted = jax.jit(run)``
+    inside a method marks only the ``run`` defined in THAT function's
+    scope, not an unrelated method of the same name elsewhere in the
+    module (class bodies are not function scopes, so a method's scope
+    is the module — the pattern that produced false positives)."""
+
+    def __init__(self, module: ast.Module):
+        self._module = module
+        self.functions: List[ast.FunctionDef] = []
+        self.fn_scope: Dict[int, int] = {}      # id(fn) -> id(scope)
+        self.wrapped: Set[Tuple[int, str]] = set()
+        self.mutable_globals: Dict[str, int] = {}
+        self._scope_stack: List[ast.AST] = [module]
+        for stmt in module.body:
+            self._scan_global(stmt)
+        self.visit(module)
+
+    def is_wrapped(self, fn: ast.FunctionDef) -> bool:
+        return (self.fn_scope[id(fn)], fn.name) in self.wrapped
+
+    def _scan_global(self, stmt: ast.stmt) -> None:
+        targets: List[ast.expr] = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None or not _is_mutable_literal(value):
+            return
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.mutable_globals[t.id] = stmt.lineno
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.functions.append(node)
+        self.fn_scope[id(node)] = id(self._scope_stack[-1])
+        self._scope_stack.append(node)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._scope_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit_wrapper(node.func) and node.args and \
+                isinstance(node.args[0], ast.Name):
+            self.wrapped.add((id(self._scope_stack[-1]),
+                              node.args[0].id))
+        self.generic_visit(node)
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted(node.func).split(".")[-1] in (
+            "list", "dict", "set", "deque", "defaultdict", "OrderedDict",
+            "bytearray", "Counter")
+    return False
+
+
+# -- taint analysis ----------------------------------------------------------
+
+class Taint:
+    """Forward may-be-traced analysis over one jitted function body.
+
+    Seeds: the function's parameters (minus ``self``/``cls``).  Two
+    passes over the statement list in source order make loop-carried
+    taint converge (a name assigned late in a loop body and read early
+    the next iteration).  Deliberately conservative in BOTH directions:
+    reading static metadata (``x.shape``) does not taint, and a name
+    rebound to a clearly-concrete value is untainted again.
+    """
+
+    def __init__(self, fn: ast.FunctionDef):
+        args = fn.args
+        names = [a.arg for a in (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else []))]
+        self.tainted: Set[str] = {n for n in names
+                                  if n not in ("self", "cls")}
+        for _ in range(2):
+            self._pass(fn.body)
+
+    # -- statement walk ----------------------------------------------------
+
+    def _pass(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _targets(self, target: ast.expr) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in target.elts:
+                out.extend(self._targets(e))
+            return out
+        if isinstance(target, ast.Starred):
+            return self._targets(target.value)
+        return []                       # attribute/subscript stores
+
+    def _bind(self, target: ast.expr, tainted: bool) -> None:
+        for name in self._targets(target):
+            if tainted:
+                self.tainted.add(name)
+            else:
+                self.tainted.discard(name)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        self._bind_walrus(stmt)
+        if isinstance(stmt, ast.Assign):
+            t = self.is_traced(stmt.value)
+            for target in stmt.targets:
+                self._bind(target, t)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, self.is_traced(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if self.is_traced(stmt.value):
+                self._bind(stmt.target, True)
+        elif isinstance(stmt, ast.For):
+            self._bind_loop_target(stmt.target, stmt.iter)
+            self._pass(stmt.body)
+            self._pass(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._pass(stmt.body)
+            self._pass(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self._pass(stmt.body)
+            self._pass(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               self.is_traced(item.context_expr))
+            self._pass(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._pass(stmt.body)
+            for h in stmt.handlers:
+                self._pass(h.body)
+            self._pass(stmt.orelse)
+            self._pass(stmt.finalbody)
+        # nested defs keep the enclosing taint via is_traced on reads
+
+    def _bind_walrus(self, stmt: ast.stmt) -> None:
+        """Walrus targets bind wherever the expression appears (an
+        ``if (y := f(x)) > 0:`` test, a comprehension — PEP 572 leaks
+        those to the enclosing scope), so taint them from the bound
+        expression before the statement-shape dispatch below."""
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.NamedExpr):
+                self._bind(node.target, self.is_traced(node.value))
+
+    def _bind_loop_target(self, target: ast.expr, it: ast.expr) -> None:
+        """``for (a, b), c in zip(xs, ys)``: taint each target element
+        from the matching zip argument instead of smearing the union
+        over the whole tuple (zip of concrete metadata with traced
+        arrays is the common mixed pattern)."""
+        if (isinstance(it, ast.Call)
+                and _dotted(it.func) in ("zip", "enumerate")
+                and isinstance(target, ast.Tuple)):
+            args = it.args
+            if _dotted(it.func) == "enumerate":
+                args = [None] + list(args)      # index is concrete
+            if len(args) == len(target.elts):
+                for elt, arg in zip(target.elts, args):
+                    self._bind(elt, arg is not None
+                               and self.is_traced(arg))
+                return
+        self._bind(target, self.is_traced(it))
+
+    # -- expression query --------------------------------------------------
+
+    def is_traced(self, node: Optional[ast.AST]) -> bool:
+        """May this expression carry a traced value?  Static-metadata
+        reads and known-concrete calls are pruned."""
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            if fname.split(".")[-1] in _STATIC_CALLS:
+                return False
+            if any(self.is_traced(a) for a in node.args):
+                return True
+            if any(self.is_traced(kw.value) for kw in node.keywords):
+                return True
+            # method call ON a traced value produces a traced value
+            if isinstance(node.func, ast.Attribute):
+                return self.is_traced(node.func.value)
+            return False
+        if isinstance(node, ast.Subscript):
+            return self.is_traced(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity tests never concretize a tracer: `x is None` is a
+            # host-level structural check even when x holds one
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.is_traced(node.left) or \
+                any(self.is_traced(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return (self.is_traced(node.body) or self.is_traced(node.test)
+                    or self.is_traced(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_traced(v) for v in node.values
+                       if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return any(self.is_traced(v.value) for v in node.values
+                       if isinstance(v, ast.FormattedValue))
+        return False
+
+
+# -- per-function lint context ----------------------------------------------
+
+@dataclass
+class FunctionContext:
+    """Everything a rule needs about one function under lint."""
+
+    path: str
+    fn: ast.FunctionDef
+    taint: Optional[Taint]              # None for host (hot-path) fns
+    is_jitted: bool
+    is_hot_path: bool
+    mutable_globals: Dict[str, int]
+    source_lines: List[str]
+
+    def local_names(self) -> Set[str]:
+        """Names bound anywhere inside the function (params, assigns,
+        defs, imports) — reads of these are NOT global captures."""
+        names: Set[str] = set()
+        args = self.fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            names.add(a.arg)
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                if node is not self.fn:
+                    names.add(node.name)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    names.add((alias.asname or alias.name).split(".")[0])
+        return names
+
+
+# -- the lint driver ---------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>") -> LintResult:
+    """Lint one module's source text; returns every finding (active and
+    suppressed)."""
+    from .rules import RULES
+
+    res = LintResult(files=1)
+    pragmas = _Pragmas(source, path)
+    res.findings.extend(pragmas.bad)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        res.findings.append(Finding(
+            PARSE_ERROR, "TPL000", path, e.lineno or 0, e.offset or 0,
+            f"file does not parse: {e.msg}"))
+        return res
+    index = _JitIndex(tree)
+    src_lines = source.splitlines()
+
+    raw: List[Finding] = []
+    for fn in index.functions:
+        jitted = (any(_decorator_marks_jit(d) for d in fn.decorator_list)
+                  or index.is_wrapped(fn))
+        # fn.lineno is the `def` line; decorators sit above it, so the
+        # marker must also be honored above the first decorator
+        def_start = min([fn.lineno]
+                        + [d.lineno for d in fn.decorator_list])
+        hot = pragmas.is_hot_path(fn.lineno) \
+            or pragmas.is_hot_path(def_start)
+        if not (jitted or hot):
+            continue
+        ctx = FunctionContext(
+            path=path, fn=fn,
+            taint=Taint(fn) if jitted else None,
+            is_jitted=jitted, is_hot_path=hot,
+            mutable_globals=index.mutable_globals,
+            source_lines=src_lines)
+        for rule in RULES.values():
+            if rule.scope == "jit" and not jitted:
+                continue
+            if rule.scope == "hot-path" and not hot:
+                continue
+            raw.extend(rule.check(ctx))
+
+    # apply suppressions — findings print as `TPL102(traced-coerce)`,
+    # so both the code and the name are accepted in disable= lists
+    for f in raw:
+        hit = pragmas.lookup(f.line, f.rule) or pragmas.lookup(f.line, f.code)
+        if hit is not None:
+            f.suppressed, f.reason = True, hit[1]
+    res.findings.extend(raw)
+
+    # orphan suppressions referencing unknown rules are themselves
+    # findings: a typo'd rule name must not silently suppress nothing
+    from .rules import rule_codes
+    known = (set(rule_codes()) | {r.code for r in RULES.values()}
+             | {BAD_SUPPRESSION, PARSE_ERROR})
+    for line, (rules, reason) in sorted(pragmas.suppress.items()):
+        unknown = sorted(r for r in rules if r not in known)
+        if unknown:
+            res.findings.append(Finding(
+                BAD_SUPPRESSION, "TPL100", path, line, 0,
+                f"suppression names unknown rule(s): {', '.join(unknown)}"))
+    return res
+
+
+def lint_file(path: str) -> LintResult:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Iterable[str],
+               exclude: Iterable[str] = ()) -> LintResult:
+    """Lint every ``.py`` file under the given files/directories."""
+    res = LintResult()
+    exclude = tuple(exclude)
+    for root in paths:
+        if os.path.isfile(root):
+            res.extend(lint_file(root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, fname)
+                if any(x in fpath for x in exclude):
+                    continue
+                res.extend(lint_file(fpath))
+    return res

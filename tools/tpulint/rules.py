@@ -1,0 +1,265 @@
+"""tpulint rule registry.
+
+Each rule is a function from a :class:`~.linter.FunctionContext` to an
+iterator of :class:`~.linter.Finding`, registered under a stable code
+(``TPL1xx``) and a kebab-case name (the name users suppress by).  Rules
+declare a *scope*:
+
+- ``"jit"`` — runs over statically-identified jit-compiled functions
+  (taint analysis available: ``ctx.taint``);
+- ``"hot-path"`` — runs over host functions marked ``# tpulint:
+  hot-path`` (the serving decode loop), where every device→host
+  coercion is per-token cost and must be individually justified.
+
+Adding a rule is one ``@register(...)`` function — the CLI, the
+suppression checker, and the test harness pick it up from ``RULES``.
+
+Why these rules (the recompile/host-sync hazard model, see
+docs/ANALYSIS.md):
+
+- a python ``if``/``while`` on a traced value either crashes the trace
+  (TracerBoolConversionError) or — worse — silently re-specializes and
+  adds a compile key per distinct value;
+- ``int()``/``float()``/``bool()``/``.item()``/``np.asarray`` on a
+  traced value forces a device→host sync at trace time (or a
+  ConcretizationTypeError), and in host code is a per-call transfer;
+- a captured mutable global is invisible to the executable-cache key:
+  mutating it after compilation silently serves stale constants;
+- a non-hashable default (list/dict/set) on a jitted function cannot
+  participate in a cache key and aliases one mutable object across
+  every trace;
+- f-string/print of a traced value concretizes it (sync or crash) and
+  is almost always leftover debug code.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List
+
+from .linter import Finding, FunctionContext, _dotted
+
+__all__ = ["RULES", "Rule", "register", "rule_codes"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str                   # "TPL101"
+    name: str                   # "traced-branch"
+    scope: str                  # "jit" | "hot-path"
+    summary: str
+    check: Callable[[FunctionContext], Iterator[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(code: str, name: str, scope: str, summary: str):
+    def deco(fn):
+        if name in RULES or any(r.code == code for r in RULES.values()):
+            raise ValueError(f"duplicate rule {code}/{name}")
+        RULES[name] = Rule(code, name, scope, summary, fn)
+        return fn
+    return deco
+
+
+def rule_codes() -> List[str]:
+    """Suppressable rule names, registry order."""
+    return list(RULES)
+
+
+def _f(ctx: FunctionContext, rule: str, node: ast.AST,
+       message: str) -> Finding:
+    return Finding(rule, RULES[rule].code, ctx.path,
+                   getattr(node, "lineno", ctx.fn.lineno),
+                   getattr(node, "col_offset", 0), message)
+
+
+# -- TPL101: python control flow on traced values ----------------------------
+
+@register("TPL101", "traced-branch", "jit",
+          "python if/while/assert on a traced value inside a "
+          "jit-compiled function (trace error or a silent per-value "
+          "compile key)")
+def traced_branch(ctx: FunctionContext) -> Iterator[Finding]:
+    t = ctx.taint
+    for node in ast.walk(ctx.fn):
+        if isinstance(node, ast.If) and t.is_traced(node.test):
+            yield _f(ctx, "traced-branch", node,
+                     "`if` on a traced value: use jnp.where / "
+                     "static.nn.cond, or hoist the decision to a "
+                     "concrete argument")
+        elif isinstance(node, ast.While) and t.is_traced(node.test):
+            yield _f(ctx, "traced-branch", node,
+                     "`while` on a traced value: use "
+                     "static.nn.while_loop / lax.while_loop")
+        elif isinstance(node, ast.Assert) and t.is_traced(node.test):
+            yield _f(ctx, "traced-branch", node,
+                     "`assert` on a traced value concretizes it at "
+                     "trace time: use checkify or drop the assert")
+        elif isinstance(node, ast.IfExp) and t.is_traced(node.test):
+            yield _f(ctx, "traced-branch", node,
+                     "conditional expression on a traced value: use "
+                     "jnp.where")
+        elif isinstance(node, ast.comprehension):
+            for cond in node.ifs:
+                if t.is_traced(cond):
+                    yield _f(ctx, "traced-branch", cond,
+                             "comprehension filter on a traced value "
+                             "concretizes it per element")
+
+
+# -- TPL102: concretizing coercions of traced values -------------------------
+
+_COERCE_BUILTINS = {"int", "float", "bool", "complex"}
+_COERCE_METHODS = {"item", "numpy", "tolist", "__array__"}
+_COERCE_NP_FUNCS = {"asarray", "array", "asanyarray", "ascontiguousarray"}
+
+
+def _is_np_coercion(func: ast.AST) -> bool:
+    name = _dotted(func)
+    if "." not in name:
+        return False
+    head, _, tail = name.rpartition(".")
+    return tail in _COERCE_NP_FUNCS and head.split(".")[0] in (
+        "np", "numpy")
+
+
+@register("TPL102", "traced-coerce", "jit",
+          "int()/float()/bool()/.item()/.numpy()/np.asarray of a traced "
+          "value in a compiled path (device→host sync or trace crash)")
+def traced_coerce(ctx: FunctionContext) -> Iterator[Finding]:
+    t = ctx.taint
+    for node in ast.walk(ctx.fn):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname in _COERCE_BUILTINS and node.args \
+                and t.is_traced(node.args[0]):
+            yield _f(ctx, "traced-coerce", node,
+                     f"`{fname}()` of a traced value concretizes it at "
+                     "trace time: keep it on device (astype / "
+                     "jnp ops), or make it a static argument")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _COERCE_METHODS \
+                and t.is_traced(node.func.value):
+            yield _f(ctx, "traced-coerce", node,
+                     f"`.{node.func.attr}()` on a traced value forces a "
+                     "device→host sync inside the compiled path")
+        elif _is_np_coercion(node.func) and node.args \
+                and t.is_traced(node.args[0]):
+            yield _f(ctx, "traced-coerce", node,
+                     f"`{fname}()` of a traced value pulls it to host "
+                     "at trace time: use jnp.asarray, or hoist the "
+                     "conversion out of the compiled function")
+
+
+# -- TPL103: captured mutable globals ---------------------------------------
+
+@register("TPL103", "mutable-global", "jit",
+          "jit-compiled function reads a module-level mutable object "
+          "(list/dict/set): invisible to the compile-cache key, so "
+          "mutations after compilation silently serve stale constants")
+def mutable_global(ctx: FunctionContext) -> Iterator[Finding]:
+    if not ctx.mutable_globals:
+        return
+    local = ctx.local_names()
+    seen = set()
+    for node in ast.walk(ctx.fn):
+        if not (isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        name = node.id
+        if name in local or name not in ctx.mutable_globals \
+                or name in seen:
+            continue
+        seen.add(name)
+        yield _f(ctx, "mutable-global", node,
+                 f"reads module-level mutable `{name}` (defined at "
+                 f"line {ctx.mutable_globals[name]}): captured as a "
+                 "trace-time constant — pass it as an argument or "
+                 "freeze it (tuple / frozenset)")
+
+
+# -- TPL104: non-hashable static args ---------------------------------------
+
+@register("TPL104", "nonhashable-static", "jit",
+          "mutable (non-hashable) default on a jit-compiled function: "
+          "it cannot key the executable cache and is one shared object "
+          "across every trace")
+def nonhashable_static(ctx: FunctionContext) -> Iterator[Finding]:
+    from .linter import _is_mutable_literal
+
+    args = ctx.fn.args
+    # align trailing defaults with their params
+    pos_named = list(args.posonlyargs) + list(args.args)
+    pairs = list(zip(pos_named[len(pos_named) - len(args.defaults):],
+                     args.defaults))
+    pairs += [(a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+              if d is not None]
+    for param, default in pairs:
+        if _is_mutable_literal(default):
+            yield _f(ctx, "nonhashable-static", default,
+                     f"parameter `{param.arg}` defaults to a mutable "
+                     "object: non-hashable, so it can't participate in "
+                     "the compile-cache key (use None + in-function "
+                     "init, or a tuple/frozenset)")
+
+
+# -- TPL105: f-string / print of traced values -------------------------------
+
+@register("TPL105", "traced-format", "jit",
+          "f-string/print/str.format of a traced value inside a "
+          "jit-compiled function (concretizes mid-trace; almost always "
+          "leftover debug code — use jax.debug.print)")
+def traced_format(ctx: FunctionContext) -> Iterator[Finding]:
+    t = ctx.taint
+    for node in ast.walk(ctx.fn):
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue) \
+                        and t.is_traced(v.value):
+                    yield _f(ctx, "traced-format", node,
+                             "f-string interpolates a traced value: "
+                             "use jax.debug.print (async, no sync) or "
+                             "drop it")
+                    break
+        elif isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            if fname == "print" and any(
+                    t.is_traced(a) for a in node.args):
+                yield _f(ctx, "traced-format", node,
+                         "print of a traced value: use jax.debug.print "
+                         "(async, no sync) or drop it")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "format" \
+                    and any(t.is_traced(a) for a in node.args):
+                yield _f(ctx, "traced-format", node,
+                         ".format of a traced value concretizes it "
+                         "mid-trace")
+
+
+# -- TPL106: device→host syncs on the serving hot path -----------------------
+
+_SYNC_METHODS = {"numpy", "item", "tolist"}
+
+
+@register("TPL106", "host-sync", "hot-path",
+          "device→host coercion (.numpy()/.item()/.tolist()/np.asarray) "
+          "in a `# tpulint: hot-path` function: per-token transfer on "
+          "the serving decode path — justify each one")
+def host_sync(ctx: FunctionContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS:
+            yield _f(ctx, "host-sync", node,
+                     f"`.{node.func.attr}()` on the serving hot path is "
+                     "a per-step device→host transfer: keep the value "
+                     "on device (ROADMAP item 2: on-device sampling) "
+                     "or suppress with the reason it must cross")
+        elif _is_np_coercion(node.func):
+            yield _f(ctx, "host-sync", node,
+                     f"`{_dotted(node.func)}()` on the serving hot path "
+                     "copies through host memory every step")
