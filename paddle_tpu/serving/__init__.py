@@ -19,6 +19,13 @@ deadlines, bounded-queue backpressure (:class:`QueueFull`), bounded step
 retry, watchdog-backed hang detection, and ``drain()`` / ``shutdown()`` /
 ``health()`` lifecycle — see docs/SERVING.md "Failure semantics".
 
+Overload is a first-class regime: request priority classes with
+deferral aging, preemption of lower-priority work under slot/block
+pressure (cheap resume via the prefix cache, stream restart from token
+0), and SLO-aware admission shedding (:class:`ShedReject` with
+``retry_after_s``) — see docs/SERVING.md "Overload, priorities &
+preemption".
+
 One level up, the fleet degrades per-replica, never per-fleet:
 :class:`Fleet` supervises N engine replicas behind one
 submit/stream/cancel surface — prefix-affinity dispatch, health-driven
@@ -37,13 +44,15 @@ from .sampling import SamplingParams, sample  # noqa: F401
 from .sanitize import SyncSanitizer  # noqa: F401
 from .metrics import ServingMetrics, FleetMetrics  # noqa: F401
 from .engine import (  # noqa: F401
-    Engine, Request, QueueFull, EngineStopped,
+    Engine, Request, QueueFull, ShedReject, EngineStopped,
+    PRIORITY_LOW, PRIORITY_NORMAL, PRIORITY_HIGH,
 )
 from .router import Fleet, FleetRequest  # noqa: F401
 
 __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "SamplingParams", "ServingMetrics", "sample",
-           "QueueFull", "EngineStopped",
+           "QueueFull", "ShedReject", "EngineStopped",
+           "PRIORITY_LOW", "PRIORITY_NORMAL", "PRIORITY_HIGH",
            "BlockAllocator", "PagedKVCache", "PagedCacheContext",
            "PrefixCache", "AllocatorError",
            "Fleet", "FleetRequest", "FleetMetrics", "SyncSanitizer"]

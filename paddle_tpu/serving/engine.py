@@ -32,6 +32,26 @@ enforced in ``step()``, and ``drain()``/``shutdown()``/``health()`` give
 the engine an explicit lifecycle.  None of this changes any compiled
 shape: deadlines, cancellation, and retirement only alter argument
 values, so the zero-recompile steady state survives every failure path.
+
+Overload (docs/SERVING.md "Overload, priorities & preemption"): sustained
+pressure is a first-class regime, not a failure mode.  Requests carry a
+**priority class** (``PRIORITY_LOW|NORMAL|HIGH`` or any int); the queue
+is served highest-effective-priority first with **deferral aging**
+(``priority_aging_s`` — a waiting request's effective priority rises over
+time, so low-priority work is never starved).  When no slot — or, in
+paged mode, no KV block — can serve a higher-priority admission, the
+scheduler **preempts** the lowest-priority running victim: its prompt
+blocks are registered in the prefix cache *before* its slot releases
+(resume becomes a cheap prefix hit), and it requeues replay-from-prompt
+with ``preempted``/``preemptions`` set and its stream restarting from
+token 0 — the fleet redispatch stream contract, one level down.  At most
+``max_preemptions`` evictions per request; past the budget a request is
+immune.  **SLO-aware shedding** rejects at admission (``ShedReject``,
+with ``retry_after_s``) any deadline-carrying request whose estimated
+queue wait already exceeds its deadline, instead of prefilling doomed
+work.  All of it is host-side bookkeeping: preemption and resume reuse
+the existing prefill buckets and add ZERO executable-cache keys
+(provable against tools/shape_manifest.json).
 """
 from __future__ import annotations
 
@@ -54,22 +74,55 @@ from .sampling import SamplingParams, sample
 from .sanitize import SyncSanitizer
 
 __all__ = ["Engine", "Request", "SamplingParams", "QueueFull",
-           "EngineStopped"]
+           "ShedReject", "EngineStopped",
+           "PRIORITY_LOW", "PRIORITY_NORMAL", "PRIORITY_HIGH"]
 
 _engine_counter = itertools.count()
 
 #: Request states a request can never leave.
 TERMINAL_STATES = frozenset({"finished", "failed", "cancelled", "rejected"})
 
+#: Priority classes (any int works; higher serves first).
+PRIORITY_LOW, PRIORITY_NORMAL, PRIORITY_HIGH = 0, 1, 2
+
+_PRIORITY_NAMES = {"low": PRIORITY_LOW, "normal": PRIORITY_NORMAL,
+                   "high": PRIORITY_HIGH}
+
+
+def _as_priority(priority) -> int:
+    """Normalize a priority class: ``"low"|"normal"|"high"`` or any int
+    (higher = served first)."""
+    if isinstance(priority, str):
+        try:
+            return _PRIORITY_NAMES[priority.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown priority {priority!r}; want one of "
+                f"{sorted(_PRIORITY_NAMES)} or an int") from None
+    return int(priority)
+
 
 class QueueFull(RuntimeError):
     """Admission rejected by backpressure: the request queue is at
     ``max_queue`` (and, under the ``block`` policy, stayed full past the
-    block timeout).  Carries the observed ``depth``."""
+    block timeout).  Carries the observed ``depth`` and the engine's
+    estimated ``retry_after_s`` (machine-readable; also mirrored on the
+    rejected handle's ``Request.error_ctx``)."""
 
-    def __init__(self, msg: str, depth: int):
+    def __init__(self, msg: str, depth: int,
+                 retry_after_s: Optional[float] = None):
         super().__init__(msg)
         self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class ShedReject(QueueFull):
+    """SLO-aware admission shed: the request carries a wall-clock
+    deadline its estimated queue wait already exceeds — prefilling it
+    would burn a compiled prefill on work that is doomed to miss its
+    SLO.  Subclasses :class:`QueueFull` so backpressure-aware callers
+    (the fleet router included) handle both identically; ``retry_after_s``
+    says when the backlog is expected to have cleared."""
 
 
 class EngineStopped(RuntimeError):
@@ -94,11 +147,24 @@ class Request:
     stream_cb: Optional[Callable[[int, "Request"], None]] = None
     request_id: int = -1
     deadline_s: Optional[float] = None   # wall-clock budget from enqueue
+    #: priority class (``PRIORITY_LOW|NORMAL|HIGH`` or any int; higher
+    #: serves first).  Queue ordering uses the *effective* priority —
+    #: this plus the deferral-aging boost — while preemption rights
+    #: compare base classes only.
+    priority: int = PRIORITY_NORMAL
 
     # lifecycle (engine-managed)
     state: str = "queued"
     _defers: int = 0                     # paged admissions deferred so far
+    #: set when the scheduler evicted this request mid-flight to serve a
+    #: higher-priority admission; the stream restarted from token 0 on
+    #: resume (``preemptions`` counts the evictions)
+    preempted: bool = False
+    preemptions: int = 0
     error: Optional[str] = None
+    #: machine-readable context for backpressure/shed rejections
+    #: (``{"depth": int, "retry_after_s": float}``)
+    error_ctx: Optional[dict] = None
     #: who a failure implicates: ``"request"`` (this request's own prompt,
     #: callback, sampling, or deadline — retrying elsewhere would fail the
     #: same way) vs ``"replica"`` (the engine's compiled step / lifecycle
@@ -196,6 +262,16 @@ class Engine:
             proceeds as a plain miss, and ``paging.prefix_lookup_errors``
             is counted — keeping degraded-mode behavior deterministic
             (the same contract as a *raising* lookup).
+        max_preemptions: how many times one request may be evicted
+            mid-flight to make room for a higher-priority admission;
+            past the budget it is immune to further preemption.  0
+            disables preemption entirely.
+        priority_aging_s: deferral-aging interval — a queued request's
+            effective priority rises by one class per this many seconds
+            of wait, so sustained high-priority traffic can never starve
+            lower classes (``None`` disables aging).  Aging affects
+            queue *ordering* only; preemption rights always compare base
+            priority classes, so equal-priority workloads never churn.
     """
 
     def __init__(self, model, *, num_slots: int = 4,
@@ -213,7 +289,9 @@ class Engine:
                  block_size: int = 16,
                  num_kv_blocks: Optional[int] = None,
                  enable_prefix_cache: bool = True,
-                 prefix_lookup_timeout_s: float = 0.25):
+                 prefix_lookup_timeout_s: float = 0.25,
+                 max_preemptions: int = 2,
+                 priority_aging_s: Optional[float] = 5.0):
         cfg = getattr(model, "config", None)
         if cfg is None:
             raise TypeError("Engine needs a model carrying a .config "
@@ -243,6 +321,11 @@ class Engine:
             raise ValueError("max_step_retries must be >= 0")
         if step_timeout_s is not None and step_timeout_s <= 0:
             raise ValueError("step_timeout_s must be > 0")
+        if max_preemptions < 0:
+            raise ValueError("max_preemptions must be >= 0")
+        if priority_aging_s is not None and priority_aging_s <= 0:
+            raise ValueError("priority_aging_s must be > 0 (or None to "
+                             "disable aging)")
         self.buckets = self._make_buckets()
         kv_heads = getattr(cfg, "n_kv_heads", None) or cfg.num_attention_heads
         if cache_dtype is None:
@@ -301,6 +384,10 @@ class Engine:
         self.max_step_retries = int(max_step_retries)
         self.retry_backoff_s = float(retry_backoff_s)
         self.step_timeout_s = step_timeout_s
+        # overload regime (priorities / preemption / shedding)
+        self.max_preemptions = int(max_preemptions)
+        self.priority_aging_s = None if priority_aging_s is None \
+            else float(priority_aging_s)
         if fault_plan is None:
             from ..distributed.fault_tolerance.injection import \
                 ServingFaultPlan
@@ -543,6 +630,15 @@ class Engine:
         req.t_finish = time.perf_counter()
         self.metrics.on_reject()
 
+    @staticmethod
+    def _fresh_rng(req: Request) -> np.random.RandomState:
+        """The request's sampling RNG, reconstructible: preemption
+        replays (replay-from-prompt) re-seed identically, so seeded
+        sampling resumes deterministically (greedy ignores the RNG)."""
+        return np.random.RandomState(
+            req.sampling.seed if req.sampling.seed is not None
+            else (req.request_id + 1) * 7919)
+
     def add_request(self, prompt_ids: Sequence[int], *,
                     max_new_tokens: int = 16,
                     sampling: Optional[SamplingParams] = None,
@@ -550,7 +646,8 @@ class Engine:
                     eos_token_id: Optional[int] = None,
                     stream_cb: Optional[Callable] = None,
                     deadline_s: Optional[float] = None,
-                    block_timeout_s: Optional[float] = None) -> Request:
+                    block_timeout_s: Optional[float] = None,
+                    priority=PRIORITY_NORMAL) -> Request:
         """Enqueue a prompt; it is admitted into a slot by a later
         ``step()``.  Returns the live Request handle.
 
@@ -559,7 +656,13 @@ class Engine:
         full queue raises :class:`QueueFull` under the ``reject`` policy,
         or blocks (driving ``step()``) up to ``block_timeout_s`` under
         ``block``.  ``deadline_s`` is this request's wall-clock budget
-        from enqueue (default: the engine's ``default_deadline_s``)."""
+        from enqueue (default: the engine's ``default_deadline_s``); a
+        deadline-carrying request whose estimated queue wait already
+        exceeds that budget is shed at admission (:class:`ShedReject`,
+        with ``retry_after_s``) instead of being prefilled doomed.
+        ``priority`` is the request's class (``"low"|"normal"|"high"`` or
+        any int; higher serves first, may preempt strictly lower)."""
+        prio = _as_priority(priority)
         if self.state != "active":
             raise EngineStopped(
                 f"engine {self.name!r} is {self.state}: not admitting "
@@ -572,12 +675,26 @@ class Engine:
                       stream_cb=stream_cb,
                       deadline_s=(deadline_s if deadline_s is not None
                                   else self.default_deadline_s),
+                      priority=prio,
                       request_id=next(self._req_counter))
         req.t_enqueue = time.perf_counter()
         problem = self._validate(req)
         if problem is not None:
             self._reject(req, problem)
             err = ValueError(problem)
+            err.request = req
+            raise err
+        wait = self._shed_wait_s(req)
+        if wait is not None:
+            depth = len(self.queue)
+            msg = (f"shed: estimated queue wait {wait:.3f}s exceeds "
+                   f"deadline {req.deadline_s}s (depth={depth}, "
+                   f"retry_after_s={wait:.3f})")
+            req.error_ctx = {"depth": depth,
+                             "retry_after_s": round(wait, 3)}
+            self.metrics.on_shed()
+            self._reject(req, msg)
+            err = ShedReject(msg, depth, retry_after_s=round(wait, 3))
             err.request = req
             raise err
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
@@ -591,15 +708,16 @@ class Engine:
                     self.step()          # drain: admit/decode in-flight work
             if len(self.queue) >= self.max_queue:
                 depth = len(self.queue)
+                retry = round(self.estimate_queue_wait_s(req.priority), 3)
                 msg = (f"queue full: {depth} >= max_queue={self.max_queue} "
-                       f"(policy={self.queue_policy})")
+                       f"(policy={self.queue_policy}, "
+                       f"retry_after_s={retry})")
+                req.error_ctx = {"depth": depth, "retry_after_s": retry}
                 self._reject(req, msg)
-                err = QueueFull(msg, depth)
+                err = QueueFull(msg, depth, retry_after_s=retry)
                 err.request = req
                 raise err
-        req._rng = np.random.RandomState(
-            sampling.seed if sampling.seed is not None
-            else (req.request_id + 1) * 7919)
+        req._rng = self._fresh_rng(req)
         req._engine = weakref.ref(self)
         self.queue.append(req)
         self.metrics.on_enqueue(len(self.queue))
@@ -655,6 +773,161 @@ class Engine:
         self.metrics.on_deadline()
         self._retire(req, "failed",
                      error=f"deadline of {req.deadline_s}s exceeded")
+
+    # -- overload: priorities, preemption, shedding ------------------------
+
+    def _effective_priority(self, req: Request, now: float) -> int:
+        """Base priority class plus the deferral-aging boost (+1 class
+        per ``priority_aging_s`` of queue wait) — the no-starvation
+        ordering: sustained higher-priority arrivals cannot hold a
+        waiting request back forever."""
+        if self.priority_aging_s is None:
+            return req.priority
+        return req.priority + int(
+            max(0.0, now - req.t_enqueue) / self.priority_aging_s)
+
+    def _best_queued_index(self, now: float) -> Optional[int]:
+        """Index of the next request to admit: highest effective
+        priority, FIFO within a class (the first maximum wins, and the
+        deque keeps arrival order)."""
+        best_i, best_eff = None, None
+        for i, q in enumerate(self.queue):
+            eff = self._effective_priority(q, now)
+            if best_eff is None or eff > best_eff:
+                best_i, best_eff = i, eff
+        return best_i
+
+    def _best_preempting_candidate(self, now: float):
+        """With every slot busy, the queued request that should preempt:
+        highest effective priority among those for which a victim
+        exists.  The effective head of the queue may hold NO preemption
+        rights (aging grants queue position, never eviction — e.g. an
+        aged low ahead of a fresh high over all-normal slots); it keeps
+        its position for the next natural retirement while the
+        entitled request evicts past it.  Returns
+        ``(index, request, victim)`` or ``(None, None, None)``."""
+        best, best_eff = (None, None, None), None
+        for i, q in enumerate(self.queue):
+            if q.done:
+                continue
+            eff = self._effective_priority(q, now)
+            if best_eff is not None and eff <= best_eff:
+                continue
+            victim = self._pick_victim(q)
+            if victim is not None:
+                best, best_eff = (i, q, victim), eff
+        return best
+
+    def estimate_queue_wait_s(self,
+                              priority: int = PRIORITY_NORMAL) -> float:
+        """Estimated wall-clock wait before a fresh request of
+        ``priority`` reaches a slot: the backlog it must wait behind
+        (running requests' remaining token budgets plus queued requests
+        at >= its effective priority) priced at the measured mean
+        inter-token latency, spread over the decode batch width.
+
+        Advisory and conservative by construction: a cold engine (no
+        decode measurements yet) estimates 0.0 — admission never sheds
+        on a guess — and a request the free slots can absorb this step
+        waits 0.0.  Shared by SLO shedding and the fleet router's
+        ``retry_after_s``."""
+        if not self.metrics.itl_s:
+            return 0.0
+        now = time.perf_counter()
+        queued_ahead = [q for q in self.queue
+                        if self._effective_priority(q, now)
+                        >= int(priority)]
+        if len(queued_ahead) < len(self.free_slots):
+            return 0.0
+        itl = sum(self.metrics.itl_s) / len(self.metrics.itl_s)
+        tokens = sum(max(0, r.max_new_tokens - len(r.output_ids))
+                     for r in self.running.values())
+        tokens += sum(q.max_new_tokens for q in queued_ahead)
+        return tokens * itl / max(self.num_slots, 1)
+
+    def _shed_wait_s(self, req: Request) -> Optional[float]:
+        """SLO shed decision at admission: the estimated queue wait when
+        it already exceeds the request's wall-clock deadline (the
+        request could not finish in time even if decode were free), else
+        None.  Deadline-less requests are never shed.  Preemption
+        entitlement trumps the backlog estimate: a request that would
+        evict its way into a slot on its first scheduling pass does not
+        wait behind the running backlog, so it is never shed on it.  A
+        queued request contends for that entitlement only if it could
+        WIN the preemption pass — effective priority at >= this class
+        AND a victim of its own (mirroring
+        ``_best_preempting_candidate``: an aged victimless head never
+        blocks the entitled preemptor there, so it must not force a
+        shed here either)."""
+        if req.deadline_s is None:
+            return None
+        now = time.perf_counter()
+        if self._pick_victim(req) is not None and not any(
+                not q.done and self._effective_priority(q, now)
+                >= req.priority and self._pick_victim(q) is not None
+                for q in self.queue):
+            return None
+        wait = self.estimate_queue_wait_s(req.priority)
+        return wait if wait > req.deadline_s else None
+
+    def _pick_victim(self, candidate: Request) -> Optional[Request]:
+        """The preemption policy: among running requests of a strictly
+        LOWER base priority class than the candidate's (aging never
+        grants preemption rights — equal-priority workloads must not
+        churn) with eviction budget left, evict the lowest class first,
+        least progress (fewest emitted tokens) next, youngest last —
+        minimizing the decode work thrown away."""
+        if self.max_preemptions <= 0:
+            return None
+        cands = [r for r in self.running.values()
+                 if r.priority < candidate.priority
+                 and r.preemptions < self.max_preemptions]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.priority, len(r.output_ids),
+                                         -r.request_id))
+
+    def _preempt(self, victim: Request) -> None:
+        """Evict a running request so a higher-priority admission can
+        take its slot (or, in paged mode, its blocks).  NOT a terminal
+        transition — the victim requeues replay-from-prompt under the
+        redispatch stream contract: ``preempted``/``preemptions`` set
+        and ``output_ids`` reset BEFORE the replay's token 0, stream
+        restarting from token 0 on resume.
+
+        Resume is cheap by construction: the victim's whole prompt
+        blocks are (re-)registered in the prefix cache *before* its slot
+        releases, so the replay prefill hits the cached prefix and pays
+        only the uncached tail bucket — reusing existing prefill
+        executables, never adding a compile key."""
+        slot = victim.slot
+        if self.kv_layout == "paged" and self.prefix_cache is not None:
+            try:
+                self.prefix_cache.register(victim.prompt_ids,
+                                           self.cache.owned_blocks(slot))
+            except Exception:            # noqa: BLE001 — isolation boundary
+                self.metrics.on_prefix_register_error()
+        self.running.pop(slot, None)
+        if slot not in self.free_slots:
+            self.free_slots.append(slot)
+        if self.kv_layout == "paged":
+            try:
+                self.cache.release_slot(slot)
+            except Exception as e:       # noqa: BLE001 — accounting bug
+                self._mark_block_corruption(
+                    f"release_slot({slot}) failed on preemption: "
+                    f"{type(e).__name__}: {e}")
+        victim.slot = None
+        victim.state = "queued"
+        victim.preempted = True
+        victim.preemptions += 1
+        victim.output_ids = []
+        victim.t_first_token = None
+        victim._seq_len = 0
+        victim._defers = 0
+        victim._rng = self._fresh_rng(victim)    # deterministic replay
+        self.queue.append(victim)        # aging runs from its original
+        self.metrics.on_preempt(len(self.queue))     # t_enqueue
 
     def _on_cancel(self, req: Request) -> None:
         """Queued requests leave immediately; running ones are retired at
@@ -797,7 +1070,7 @@ class Engine:
             # (hit blocks are refreshed, new full tail blocks registered)
             try:
                 self.prefix_cache.register(
-                    req.prompt_ids, self.cache._slot_blocks[req.slot])
+                    req.prompt_ids, self.cache.owned_blocks(req.slot))
             except Exception:            # noqa: BLE001 — isolation boundary
                 self.metrics.on_prefix_register_error()
         return "ok", last, bucket
@@ -811,6 +1084,12 @@ class Engine:
         scheduler re-queues the request with its slot returned."""
         if req._cancel:                  # cancelled between pop and prefill
             self._retire(req, "cancelled")
+            return None
+        if self._deadline_expired(req, time.perf_counter()):
+            # expired while queued (possibly during an earlier admission
+            # this very step): retire as a deadline failure WITHOUT
+            # paying a compiled prefill for work that is already dead
+            self._fail_deadline(req)
             return None
         L = int(req.prompt_ids.size)
         t0 = time.perf_counter()
@@ -1011,10 +1290,26 @@ class Engine:
         if self._prefill_fn is None:
             self._build_steps()
         self._reap(time.perf_counter())
-        while self.free_slots and self.queue:
-            req = self.queue.popleft()
+        while self.queue:
+            now_a = time.perf_counter()
+            i = self._best_queued_index(now_a)
+            req = self.queue[i]
             if req.done:                 # cancelled/expired while queued
+                del self.queue[i]
                 continue
+            if not self.free_slots:
+                # slot-table pressure: the entitled queued request (not
+                # necessarily the effective head — aging grants queue
+                # position, never eviction rights) may evict the
+                # lowest-priority running victim; otherwise the queue
+                # waits for a natural retirement
+                i, req, victim = self._best_preempting_candidate(now_a)
+                if victim is None:
+                    break
+                del self.queue[i]
+                self._preempt(victim)
+            else:
+                del self.queue[i]
             req.slot = self.free_slots.pop()
             try:
                 deferred = self._admit(req) is False
@@ -1027,14 +1322,22 @@ class Engine:
                                  error="admission aborted by engine error")
                 raise
             if deferred:
-                # paged mode: the pool has no blocks for this prompt right
-                # now — hand the slot back and retry once running work
-                # retires (head-of-line FCFS).  With nothing running, no
-                # block can ever free (eviction was already attempted
-                # inside alloc), so fail instead of spinning forever.
+                # paged mode: the pool has no blocks for this prompt
+                # right now — hand the slot back.  A higher-priority
+                # admission may evict a lower-priority victim (freeing
+                # its blocks) and retry immediately; otherwise requeue
+                # at the head and retry once running work retires.  With
+                # nothing running, no block can ever free (eviction was
+                # already attempted inside alloc), so fail instead of
+                # spinning forever.
                 self.free_slots.append(req.slot)
                 req.slot = None
                 req._defers += 1
+                victim = self._pick_victim(req)
+                if victim is not None:
+                    self._preempt(victim)
+                    self.queue.appendleft(req)
+                    continue
                 if self.running:
                     self.queue.appendleft(req)
                 else:

@@ -63,6 +63,11 @@ class ServingMetrics:
         self.requests_rejected = 0
         self.deadline_expired = 0
         self.callback_errors = 0
+        # overload regime (ISSUE 8): preemption evictions and SLO-shed
+        # admissions (sheds also count as rejections — a shed IS a
+        # rejection, this counter distinguishes the cause)
+        self.requests_preempted = 0
+        self.requests_shed = 0
         self.step_failures = 0
         self.step_retries = 0
         self.retries_by_point: Dict[str, int] = {}
@@ -132,6 +137,19 @@ class ServingMetrics:
 
     def on_deadline(self) -> None:
         self.deadline_expired += 1
+
+    def on_preempt(self, depth: int) -> None:
+        """A running request was evicted for a higher-priority admission
+        and requeued (NOT a terminal outcome — the request resumes)."""
+        self.requests_preempted += 1
+        self.queue_depth = depth
+        self.queue_depth_max = max(self.queue_depth_max, depth)
+
+    def on_shed(self) -> None:
+        """An admission was SLO-shed: its estimated queue wait already
+        exceeded its deadline, so it was rejected with ``retry_after_s``
+        instead of prefilled doomed."""
+        self.requests_shed += 1
 
     def on_callback_error(self) -> None:
         self.callback_errors += 1
@@ -215,6 +233,8 @@ class ServingMetrics:
             },
             "health": self.health_cb() if self.health_cb is not None
             else None,
+            "overload": {"preemptions": self.requests_preempted,
+                         "shed": self.requests_shed},
             "paging": self._paging_section(),
             "queue_depth": self.queue_depth,
             "queue_depth_max": self.queue_depth_max,

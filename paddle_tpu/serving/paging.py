@@ -420,6 +420,13 @@ class PagedKVCache:
 
     # -- host-side management ---------------------------------------------
 
+    def owned_blocks(self, slot: int) -> List[int]:
+        """The block ids ``slot`` holds a ref on, in table-index order —
+        the engine's handle for prefix-cache registration (at admission,
+        and again on preemption BEFORE the victim's slot releases, so a
+        preempted request's resume is a cheap prefix hit)."""
+        return self._slot_blocks[slot]
+
     def length_of(self, slot: int) -> int:
         return int(self.lengths.numpy()[slot])
 

@@ -53,7 +53,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .engine import Engine, EngineStopped, QueueFull, Request
+from .engine import (Engine, EngineStopped, PRIORITY_NORMAL, QueueFull,
+                     Request, ShedReject, _as_priority)
 from .metrics import FleetMetrics
 from .sampling import SamplingParams
 
@@ -89,15 +90,27 @@ class FleetRequest:
     # lifecycle (fleet-managed)
     state: str = "pending"
     error: Optional[str] = None
+    #: machine-readable backpressure/shed context — same fields as the
+    #: engine-level ``Request.error_ctx`` (``depth``, ``retry_after_s``)
+    error_ctx: Optional[dict] = None
     output_ids: List[int] = field(default_factory=list)
     redispatches: int = 0
     redispatched: bool = False
+    #: engine-level preemption markers mirrored from the CURRENT attempt
+    #: (a preempted stream restarts from token 0, marked — the same
+    #: contract as ``redispatched``, one level down)
+    preempted: bool = False
+    preemptions: int = 0
     #: engine names this request was dispatched to, in order
     replica_history: List[str] = field(default_factory=list)
     t_submit: float = 0.0
     t_finish: Optional[float] = None
     _attempt: Optional[Request] = field(default=None, repr=False)
     _cancel: bool = False
+    #: a replica shed this request during the dispatch hunt (the final
+    #: rejection may be another replica's plain QueueFull — the fleet
+    #: shed counter must still see it)
+    _shed_seen: bool = field(default=False, repr=False)
     _fleet: Optional[object] = field(default=None, repr=False)
 
     @property
@@ -219,6 +232,13 @@ class Fleet:
         self._req_counter = itertools.count()
         self._rr = 0                     # least-loaded tie-break rotation
         self._tick = 0
+        #: preemptions of engines that left rotation (ejected / dead) —
+        #: live engines are summed on top in ``stats()``
+        self._banked_preemptions = 0
+        #: fleet-level shed SUBMITS (counted once per request, however
+        #: many replicas shed it while the dispatch hunted for one that
+        #: would take it — the per-replica rows keep the raw decisions)
+        self._sheds = 0
 
     # -- replica construction ----------------------------------------------
 
@@ -273,7 +293,17 @@ class Fleet:
             entry = self._attempts.get(ereq)
             if entry is None or entry[0] is not freq:
                 return               # stale attempt from an ejected replica
-            freq.output_ids.append(int(tok))
+            # mirror the attempt's stream in lockstep, preserving the
+            # fleet handle's list identity: the steady state is one
+            # append per token; an engine-level preemption reset the
+            # attempt's output_ids and restarted its stream from token
+            # 0, so any length mismatch resyncs in place
+            if len(ereq.output_ids) == len(freq.output_ids) + 1:
+                freq.output_ids.append(int(tok))
+            else:
+                freq.output_ids[:] = ereq.output_ids
+            freq.preempted = freq.preempted or ereq.preempted
+            freq.preemptions = ereq.preemptions
             if freq.stream_cb is not None:
                 freq.stream_cb(int(tok), freq)
         return cb
@@ -317,8 +347,10 @@ class Fleet:
                              if hasattr(e, "request") else str(e))
                 e.request = freq
                 raise
-            except (QueueFull, EngineStopped):
+            except (QueueFull, EngineStopped) as e:
                 # this replica can't take it right now — try another
+                if isinstance(e, ShedReject):
+                    freq._shed_seen = True
                 excluded.append(rep)
                 if pin is not None or not self._active(excluded):
                     raise
@@ -340,6 +372,7 @@ class Fleet:
                stream_cb: Optional[Callable] = None,
                done_cb: Optional[Callable] = None,
                deadline_s: Optional[float] = None,
+               priority=None,
                replica: Optional[int] = None) -> FleetRequest:
         """Enqueue a prompt on the fleet; returns the live
         :class:`FleetRequest` handle.
@@ -350,7 +383,10 @@ class Fleet:
         depth is at ``max_queue`` raises :class:`QueueFull`; malformed
         prompts raise ``ValueError`` with the rejected handle on
         ``.request``.  ``deadline_s`` is a per-ATTEMPT wall-clock budget
-        (it restarts on redispatch — a replay is a fresh prefill)."""
+        (it restarts on redispatch — a replay is a fresh prefill).
+        ``priority`` (``"low"|"normal"|"high"`` or an int) rides in the
+        dispatch kwargs, so it is preserved verbatim across redispatch —
+        a replayed request keeps its class on the surviving replica."""
         if self.state != "active":
             raise EngineStopped(
                 f"fleet {self.name!r} is {self.state}: not admitting "
@@ -362,6 +398,8 @@ class Fleet:
         kwargs = {"max_new_tokens": int(max_new_tokens),
                   "eos_token_id": eos_token_id,
                   "deadline_s": deadline_s}
+        if priority is not None:
+            kwargs["priority"] = priority
         if sampling is not None:
             kwargs["sampling"] = sampling
         freq = FleetRequest(prompt_ids=prompt,
@@ -370,14 +408,33 @@ class Fleet:
                             kwargs=kwargs)
         freq.t_submit = time.perf_counter()
         freq._fleet = weakref.ref(self)
+        try:
+            # normalized for the backpressure estimate only — kwargs keep
+            # the caller's value verbatim for redispatch
+            prio = _as_priority(kwargs.get("priority", PRIORITY_NORMAL))
+        except ValueError as e:
+            # a malformed priority must not leave the handle pending:
+            # rejected exactly once, same contract as enqueue validation
+            self._finish(freq, "rejected", error=str(e))
+            e.request = freq
+            raise
         if self.max_queue is not None:
             depth = sum(len(rep.engine.queue) for rep in self._active())
             if depth >= self.max_queue:
+                # retry_after_s aggregates the same estimator the
+                # engine-level shed uses — priced at THIS request's
+                # priority class: the soonest any active replica expects
+                # the backlog ahead of it to clear
+                waits = [rep.engine.estimate_queue_wait_s(prio)
+                         for rep in self._active()]
+                retry = round(min(waits), 3) if waits else 0.0
                 msg = (f"fleet queue full: {depth} >= "
                        f"max_queue={self.max_queue} across "
-                       f"{len(self._active())} active replicas")
+                       f"{len(self._active())} active replicas "
+                       f"(retry_after_s={retry})")
+                freq.error_ctx = {"depth": depth, "retry_after_s": retry}
                 self._finish(freq, "rejected", error=msg)
-                err = QueueFull(msg, depth)
+                err = QueueFull(msg, depth, retry_after_s=retry)
                 err.request = freq
                 raise err
         try:
@@ -385,7 +442,13 @@ class Fleet:
         except (QueueFull, EngineStopped) as e:
             # no replica could take it: the handle must still terminate
             # (rejected, exactly once) — a submit can never leave a
-            # pending request the fleet no longer tracks
+            # pending request the fleet no longer tracks.  Backpressure
+            # and shed context stays machine-readable fleet-side.
+            if isinstance(e, QueueFull):
+                freq.error_ctx = {"depth": e.depth,
+                                  "retry_after_s": e.retry_after_s}
+            if isinstance(e, ShedReject) or freq._shed_seen:
+                self._sheds += 1         # once per request, not per replica
             if not freq.done:
                 self._finish(freq, "rejected", error=str(e))
             e.request = freq
@@ -584,6 +647,9 @@ class Fleet:
         rep.ejections += 1
         rep._eject_t = time.perf_counter()
         rep.last_error = reason
+        # the engine leaves rotation: bank its preemption counter so
+        # the fleet aggregate survives the rebuild's fresh engine
+        self._banked_preemptions += rep.engine.metrics.requests_preempted
         self.metrics.on_eject()
         err = f"replica {rep.engine.name!r} ejected: {reason}"
         orphans = []
@@ -716,8 +782,23 @@ class Fleet:
                 "slots_total": eng.num_slots,
                 "occupancy": round(m.occupancy(), 4),
                 "compile_misses": m.compile_misses,
+                "preemptions": m.requests_preempted,
+                "shed": m.requests_shed,
             })
         return rows
+
+    def _overload_section(self) -> dict:
+        """Fleet-wide overload totals: preemptions are per-engine events
+        (banked from ejected engines plus every in-rotation engine's
+        live counter); ``shed`` counts fleet-level shed *submits* —
+        once per request, even when several replicas shed it before the
+        dispatch gave up."""
+        pre = self._banked_preemptions
+        for rep in self.replicas:
+            if rep.state != "active":
+                continue                 # ejected engines are banked
+            pre += rep.engine.metrics.requests_preempted
+        return {"preemptions": pre, "shed": self._sheds}
 
     def health(self) -> dict:
         """Fleet liveness probe: fleet state, per-replica health, and
@@ -740,6 +821,7 @@ class Fleet:
         out = self.metrics.snapshot()
         out["state"] = self.state
         out["pending"] = self.pending
+        out["overload"] = self._overload_section()
         out["engines"] = {rep.engine.name: rep.engine.stats()
                           for rep in self.replicas}
         return out

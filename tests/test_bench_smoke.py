@@ -61,6 +61,19 @@ def test_serving_mode_emits_json_line():
     assert out["serving_fleet_tokens_per_sec"] > 0
     assert out["serving_fleet_failover_recovery_ms"] > 0
     assert out["serving_fleet_redispatches"] >= 1
+    # overload trace-replay (ISSUE 8): p50/p99 TTFT and ITL under a
+    # seeded Poisson overload, the preemption/shed counters actually
+    # fired, and priority scheduling beat the no-priority baseline on
+    # the identical trace (bench.py exits nonzero otherwise — these
+    # assertions pin the fields onto the one-JSON-line contract)
+    assert out["serving_ttft_p50_ms"] > 0
+    assert out["serving_ttft_p99_ms"] >= out["serving_ttft_p50_ms"]
+    assert out["serving_itl_p50_ms"] > 0
+    assert out["serving_itl_p99_ms"] >= out["serving_itl_p50_ms"]
+    assert out["serving_preemptions"] >= 1
+    assert out["serving_shed"] >= 1
+    assert out["serving_high_ttft_p99_ms"] < \
+        out["serving_baseline_high_ttft_p99_ms"]
 
 
 def test_preflight_failure_is_structured():

@@ -395,6 +395,129 @@ class TestFleetResilience:
         assert r2.finished
         assert r2.replica_history == [fleet.replicas[1].engine.name]
 
+    def test_replica_kill_preemption_race_priority_preserved(self, gpt):
+        """ISSUE 8 satellite: a replica kill and a preemption race on
+        the same request.  A low-priority request is preempted on
+        replica 0 by a high-priority arrival (sitting requeued when the
+        scoped fault then kills r0's decode), so BOTH the preempted
+        victim and the preempting request are orphaned and redispatched
+        — terminal exactly once, priority classes preserved verbatim
+        across the redispatch, ``duplicate_terminals == 0``."""
+        from paddle_tpu.serving import PRIORITY_HIGH, PRIORITY_LOW
+
+        plan = ServingFaultPlan().add("serving.r0.decode", at_call=4,
+                                      times=2)
+        fleet = Fleet(gpt, num_replicas=2, num_slots=1, max_seq=32,
+                      min_bucket=16, kv_layout="paged", block_size=16,
+                      eject_after_failures=2, max_redispatch=2,
+                      fault_plan=plan)
+        fleet.warmup()
+        terminals = []
+        rs = np.random.RandomState(21)
+        p_lo = rs.randint(0, 128, (5,)).tolist()
+        p_hi = rs.randint(0, 128, (6,)).tolist()
+        low = fleet.submit(p_lo, max_new_tokens=6, priority="low",
+                           replica=0,
+                           done_cb=lambda r: terminals.append(
+                               r.request_id))
+        fleet.step()                    # low admitted on r0, decode #1
+        assert low._attempt is not None and low._attempt.state == "running"
+        high = fleet.submit(p_hi, max_new_tokens=6, priority="high",
+                            replica=0,
+                            done_cb=lambda r: terminals.append(
+                                r.request_id))
+        fleet.step()                    # high preempts low on r0 (1 slot)
+        assert low._attempt.preempted and low._attempt.state == "queued"
+        # drive until the scoped fault kills r0 (decode call 4, both
+        # retries) and both requests land redispatched on the survivor;
+        # capture the replayed attempts' engine-level priorities live
+        replay_prio = {}
+        for _ in range(60):
+            fleet.step()
+            for freq in (low, high):
+                att = freq._attempt
+                if freq.redispatches > 0 and att is not None:
+                    replay_prio[freq.request_id] = att.priority
+            if low.done and high.done:
+                break
+        fleet.run()
+        st = fleet.stats()
+        # terminal exactly once, both finished with full greedy outputs
+        assert sorted(terminals) == sorted(
+            [low.request_id, high.request_id])
+        assert st["requests"]["duplicate_terminals"] == 0
+        for p, r in ((p_lo, low), (p_hi, high)):
+            assert r.finished and len(r.output_ids) == 6
+            assert r.redispatches == 1 and len(r.replica_history) == 2
+            _assert_greedy_chain(gpt, p, r.output_ids)
+        # the decode-killed request replays on the SURVIVOR; the
+        # preempted victim (exported while queued) may land on either
+        # the survivor or the rebuilt replica — both are fresh engines
+        assert high.replica_history[0].endswith(".r0")
+        assert high.replica_history[1] == fleet.replicas[1].engine.name
+        # priority classes preserved verbatim across the redispatch
+        assert replay_prio[low.request_id] == PRIORITY_LOW
+        assert replay_prio[high.request_id] == PRIORITY_HIGH
+        assert low.kwargs["priority"] == "low"
+        # the ejected engine's preemption was banked into the fleet
+        # aggregate before its rebuild wiped the live counter
+        assert st["overload"]["preemptions"] >= 1
+        assert st["supervision"]["ejections"] == 1
+        assert st["supervision"]["rebuilds"] == 1
+        fleet.shutdown(timeout_s=0.0)
+
+    def test_fleet_shed_counted_on_mixed_rejection(self, gpt):
+        """A replica shed during the dispatch hunt is counted in the
+        fleet shed aggregate (once per submit) even when the FINAL
+        rejection the hunt surfaces is another replica's plain
+        QueueFull.  Host-only: nothing here compiles."""
+        from paddle_tpu.serving import ShedReject
+
+        fleet = Fleet(gpt, num_replicas=2, num_slots=1, max_seq=16,
+                      min_bucket=16)
+        # r0 (least loaded → tried first): deep backlog + ITL history,
+        # sheds any hopeless-deadline admission
+        fleet.submit([1, 2], max_new_tokens=16, replica=0)
+        fleet.replicas[0].engine.metrics.itl_s.extend([0.05] * 20)
+        # r1: at its engine-level queue bound → plain QueueFull
+        for _ in range(2):
+            fleet.submit([3, 4], max_new_tokens=4, replica=1)
+        fleet.replicas[1].engine.max_queue = 2
+        with pytest.raises(QueueFull) as qi:
+            fleet.submit([5, 6], max_new_tokens=4, deadline_s=0.001)
+        assert not isinstance(qi.value, ShedReject)  # r1's rejection won
+        assert qi.value.request.state == "rejected"
+        assert fleet.stats()["overload"]["shed"] == 1
+        fleet.shutdown(timeout_s=0.0)
+
+    def test_fleet_queue_full_retry_after_uses_request_priority(
+            self, gpt):
+        """The fleet backpressure ``retry_after_s`` is priced at the
+        rejected request's own priority class, same as the engine-level
+        path: a high request only waits behind the >=-high backlog."""
+        fleet = Fleet(gpt, num_replicas=1, num_slots=1, max_seq=16,
+                      min_bucket=16, max_queue=1)
+        fleet.submit([1, 2], max_new_tokens=16)      # normal backlog
+        fleet.replicas[0].engine.metrics.itl_s.extend([0.05] * 10)
+        with pytest.raises(QueueFull) as hi:
+            fleet.submit([3, 4], priority="high")
+        assert hi.value.retry_after_s == 0.0   # nothing queued at >= high
+        with pytest.raises(QueueFull) as lo:
+            fleet.submit([3, 4], priority="low")
+        assert lo.value.retry_after_s > 0.0    # waits behind the normal
+        assert lo.value.request.error_ctx["retry_after_s"] == \
+            lo.value.retry_after_s
+        # a malformed priority on a FULL fleet still rejects the handle
+        # exactly once (never a pending request the fleet lost track of)
+        done = []
+        with pytest.raises(ValueError) as vi:
+            fleet.submit([3, 4], priority="urgent",
+                         done_cb=done.append)
+        assert vi.value.request.state == "rejected"
+        assert [r.request_id for r in done] == [vi.value.request
+                                                .request_id]
+        fleet.shutdown(timeout_s=0.0)
+
     def test_engine_export_requests_hook(self, gpt):
         """The ejection hook: queued + in-flight requests come back in
         scheduling order, retired replica-kind, slots reclaimed."""

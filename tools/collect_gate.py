@@ -143,6 +143,7 @@ def lint_gate(env=None) -> int:
 TIER1_CRITICAL = {
     "tests/test_paging.py": "the KV block allocator",
     "tests/test_fleet.py": "fleet supervision/failover",
+    "tests/test_overload.py": "priority/preemption/shed scheduling",
 }
 
 
