@@ -79,7 +79,7 @@ from . import (  # noqa: F401
     nn, optimizer, amp, io, jit, vision, metric, distributed, autograd,
     framework, profiler, incubate, hapi, static, text, utils, inference,
     distribution, fft, signal, regularizer, hub, version, sparse, onnx,
-    serving,
+    serving, obs,
 )
 
 __version__ = version.full_version

@@ -92,7 +92,9 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.steps = self.params.get("steps")
-        self._t0 = time.time()
+        # monotonic: samples/s math must survive wall-clock steps (the
+        # serving metrics hold the same discipline — ISSUE 9 audit)
+        self._t0 = time.perf_counter()
         if self.verbose and self.params.get("verbose", 1):
             print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
 
@@ -103,7 +105,7 @@ class ProgBarLogger(Callback):
                 f"{k}: {v:.4f}" if isinstance(v, (int, float, np.floating))
                 else f"{k}: {v}" for k, v in logs.items())
             ips = ""
-            dt = time.time() - self._t0
+            dt = time.perf_counter() - self._t0
             if dt > 0 and "batch_size" in self.params:
                 ips = f" - {((step + 1) * self.params['batch_size']) / dt:.1f} samples/s"
             print(f"step {step + 1}/{self.steps or '?'} - {items}{ips}")

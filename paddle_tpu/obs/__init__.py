@@ -1,0 +1,31 @@
+"""paddle_tpu.obs — observability exporters for the serving stack.
+
+A thin, dependency-free export layer over
+:class:`paddle_tpu.serving.tracing.RequestTracer` and the
+``Engine.stats()`` / ``Fleet.stats()`` snapshots:
+
+- :func:`chrome_trace` / :func:`write_chrome_trace` — Chrome/Perfetto
+  trace-event JSON (load in https://ui.perfetto.dev or
+  ``chrome://tracing``): one track group (process) per replica, one
+  thread per slot plus a scheduler track, spans as complete events,
+  preempt/redispatch links as flow arrows, per-step batch occupancy as
+  a counter track;
+- :func:`write_jsonl` / :func:`jsonl_lines` — one JSON object per
+  event, wall-clock timestamps added AT EXPORT from the tracer's
+  anchor pair (events themselves are stamped monotonically and never
+  do wall-clock math);
+- :func:`render_metrics` / :func:`render_all_metrics` — Prometheus-
+  style text exposition of the existing ``stats()`` snapshots (no new
+  counters: this is the same dict, flattened for scrapers).
+
+Everything here is host-side and read-only: exporting never touches an
+engine, a traced value, or a compiled program.
+"""
+from .perfetto import chrome_trace, write_chrome_trace  # noqa: F401
+from .jsonl import jsonl_lines, write_jsonl  # noqa: F401
+from .metrics import render_metrics, render_all_metrics  # noqa: F401
+from ..serving.tracing import validate_trace  # noqa: F401
+
+__all__ = ["chrome_trace", "write_chrome_trace", "jsonl_lines",
+           "write_jsonl", "render_metrics", "render_all_metrics",
+           "validate_trace"]

@@ -1,0 +1,104 @@
+"""Prometheus-style text exposition of the existing serving snapshots.
+
+``Engine.stats()`` / ``Fleet.stats()`` already export everything a
+dashboard needs as nested JSON; this module flattens those SAME dicts
+into the ``name{labels} value`` text format scrapers ingest — no new
+counters, no second bookkeeping path that could drift from the real
+one.  Numeric leaves become samples, booleans become 0/1, the
+``state``-like strings become ``*_info`` gauges with the string as a
+label, and everything else is skipped.
+
+::
+
+    from paddle_tpu import obs
+    print(obs.render_metrics(engine.stats(), labels={"engine": "r0"}))
+    # paddle_tpu_serving_queue_depth{engine="r0"} 0
+    # paddle_tpu_serving_requests_completed{engine="r0"} 12
+    ...
+
+:func:`render_all_metrics` walks every live engine and fleet through
+``paddle_tpu.profiler`` — the process-wide ``/metrics`` endpoint body.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterator, Optional, Tuple
+
+__all__ = ["render_metrics", "render_all_metrics"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(*parts: str) -> str:
+    return "_".join(_NAME_RE.sub("_", str(p)).strip("_")
+                    for p in parts if str(p) != "")
+
+
+def _label_value(v) -> str:
+    """Escape per the Prometheus exposition spec: backslash, double
+    quote, and newline are the three characters that must be escaped
+    inside a label value."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _labels(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+    return "{" + body + "}"
+
+
+def _walk(node, path: Tuple[str, ...]) -> Iterator[Tuple[Tuple[str, ...],
+                                                         object]]:
+    if isinstance(node, dict):
+        for k, v in node.items():
+            yield from _walk(v, path + (str(k),))
+    elif isinstance(node, (list, tuple)):
+        # lists (per-replica tables etc.) are indexed into the name
+        for i, v in enumerate(node):
+            yield from _walk(v, path + (str(i),))
+    else:
+        yield path, node
+
+
+def render_metrics(snapshot: dict, *, prefix: str = "paddle_tpu_serving",
+                   labels: Optional[Dict[str, str]] = None) -> str:
+    """Flatten one ``stats()`` snapshot into exposition text.  ``name``
+    keys found in the snapshot become an ``engine`` label by default so
+    the same metric name aggregates across engines."""
+    labels = dict(labels or {})
+    if not labels and isinstance(snapshot.get("name"), str):
+        labels["engine"] = snapshot["name"]
+    lab = _labels(labels)
+    lines = []
+    for path, v in _walk(snapshot, ()):
+        if path and path[-1] == "name":
+            continue
+        if isinstance(v, bool):
+            v = int(v)
+        if isinstance(v, (int, float)):
+            lines.append(f"{_metric_name(prefix, *path)}{lab} {v}")
+        elif isinstance(v, str) and path and path[-1] in (
+                "state", "engine_state", "replica_state",
+                "kv_block_invariants", "kv_layout"):
+            name = _metric_name(prefix, *path) + "_info"
+            il = _labels({**labels, "value": v})
+            lines.append(f"{name}{il} 1")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_all_metrics(prefix: str = "paddle_tpu_serving") -> str:
+    """The process-wide ``/metrics`` body: every live engine's and
+    fleet's snapshot, flattened (via ``paddle_tpu.profiler``)."""
+    from .. import profiler
+
+    chunks = []
+    for name, snap in profiler.serving_stats().items():
+        chunks.append(render_metrics(snap, prefix=prefix,
+                                     labels={"engine": name}))
+    for name, snap in profiler.serving_fleet().items():
+        chunks.append(render_metrics(snap, prefix=prefix + "_fleet",
+                                     labels={"fleet": name}))
+    return "".join(chunks)

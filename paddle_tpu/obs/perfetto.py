@@ -1,0 +1,154 @@
+"""Chrome/Perfetto trace-event export of a serving RequestTracer.
+
+Produces the classic ``{"traceEvents": [...]}`` JSON the Perfetto UI
+(https://ui.perfetto.dev) and ``chrome://tracing`` load directly:
+
+- one **process** per replica (engine), named via ``process_name``
+  metadata — so a fleet renders as N side-by-side track groups;
+- one **thread** per slot (``slot 0..N-1``) plus thread 0 as the
+  replica's *scheduler* track: attempt/resume spans render on the slot
+  that served them (queued-phase and never-admitted spans on the
+  scheduler track), point events (queued/preempt/shed/eject/...) as
+  instants;
+- **flow arrows** (``ph: s``/``f``) from every ``preempt`` event to its
+  resume span and every ``redispatch`` to the replayed attempt — the
+  cross-replica story reads as connected arrows;
+- a per-replica **counter track** (``active_slots``) fed by the batched
+  per-step decode events.
+
+Timestamps are the tracer's monotonic event clock in microseconds
+(Perfetto needs only relative time); the tracer's wall-clock anchor is
+recorded once in ``metadata.wall_clock_origin`` for correlation with
+logs.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+__all__ = ["chrome_trace", "write_chrome_trace"]
+
+#: tid reserved for per-replica scheduler-level events/spans
+SCHEDULER_TID = 0
+
+#: pid for fleet-level (router) tracks: submits, dispatch, root spans
+ROUTER_PID = 0
+
+
+def _us(ts_s: float) -> float:
+    return round(ts_s * 1e6, 3)
+
+
+class _Tracks:
+    """pid/tid assignment + lazily-emitted metadata naming events."""
+
+    def __init__(self, out: List[dict]):
+        self.out = out
+        self.pids: Dict[str, int] = {}
+        self._named_threads = set()
+
+    def pid(self, replica: Optional[str]) -> int:
+        if replica is None:
+            if ROUTER_PID not in self._named_threads:
+                self._named_threads.add(ROUTER_PID)
+                self.out.append({"ph": "M", "name": "process_name",
+                                 "pid": ROUTER_PID, "tid": 0,
+                                 "args": {"name": "router"}})
+            return ROUTER_PID
+        p = self.pids.get(replica)
+        if p is None:
+            p = len(self.pids) + 1
+            self.pids[replica] = p
+            self.out.append({"ph": "M", "name": "process_name",
+                             "pid": p, "tid": 0,
+                             "args": {"name": replica}})
+        return p
+
+    def tid(self, replica: Optional[str], slot: Optional[int]) -> int:
+        p = self.pid(replica)
+        t = SCHEDULER_TID if slot is None else int(slot) + 1
+        key = (p, t)
+        if key not in self._named_threads:
+            self._named_threads.add(key)
+            self.out.append({
+                "ph": "M", "name": "thread_name", "pid": p, "tid": t,
+                "args": {"name": "scheduler" if t == SCHEDULER_TID
+                         else f"slot {t - 1}"}})
+        return t
+
+
+def chrome_trace(tracer) -> dict:
+    """Render a :class:`~paddle_tpu.serving.tracing.RequestTracer` into
+    a Perfetto-loadable trace dict (see module docstring for the track
+    layout).  Pure host-side read of the tracer's events and spans."""
+    out: List[dict] = []
+    tracks = _Tracks(out)
+    # spans -> complete events on (replica, slot)
+    for sid, sp in sorted(tracer.spans.items()):
+        t_end = sp["t_end"] if sp["t_end"] is not None else sp["t_start"]
+        pid = tracks.pid(sp["replica"])
+        tid = tracks.tid(sp["replica"], sp["slot"])
+        out.append({
+            "ph": "X", "pid": pid, "tid": tid,
+            "ts": _us(sp["t_start"]),
+            "dur": max(_us(t_end) - _us(sp["t_start"]), 0.001),
+            "name": f"{sp['name']} {sp['trace']}",
+            "cat": sp["name"],
+            "args": {"span": sid, "parent": sp["parent"],
+                     "trace": sp["trace"], "state": sp["state"]},
+        })
+    flow_id = 0
+    for ev in tracer.events:
+        kind = ev["kind"]
+        replica = ev.get("replica")
+        if kind == "decode_step":
+            pid = tracks.pid(replica)
+            out.append({"ph": "C", "pid": pid, "tid": SCHEDULER_TID,
+                        "ts": _us(ev["ts"]), "name": "active_slots",
+                        "args": {"active": ev["n_active"]}})
+            continue
+        sp = tracer.spans.get(ev.get("span"))
+        slot = sp["slot"] if sp is not None else None
+        pid = tracks.pid(replica)
+        tid = tracks.tid(replica, slot)
+        args = {k: v for k, v in ev.items()
+                if k not in ("ts", "kind", "span")}
+        out.append({"ph": "i", "s": "t", "pid": pid, "tid": tid,
+                    "ts": _us(ev["ts"]), "name": kind, "cat": kind,
+                    "args": args})
+        # linked-span flow arrows: preempt -> resume span start,
+        # redispatch -> the replayed attempt span start
+        target = None
+        if kind == "preempt":
+            target = tracer.spans.get(ev.get("resume_span"))
+        elif kind == "redispatch":
+            target = tracer.spans.get(ev.get("attempt_span"))
+        if target is not None:
+            flow_id += 1
+            out.append({"ph": "s", "id": flow_id, "pid": pid, "tid": tid,
+                        "ts": _us(ev["ts"]), "name": kind, "cat": "link"})
+            out.append({"ph": "f", "bp": "e", "id": flow_id,
+                        "pid": tracks.pid(target["replica"]),
+                        "tid": tracks.tid(target["replica"],
+                                          target["slot"]),
+                        "ts": _us(target["t_start"]), "name": kind,
+                        "cat": "link"})
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "tool": "paddle_tpu.obs",
+            "wall_clock_origin": tracer.wall0,
+            "events": len(tracer.events),
+            "dropped": tracer.dropped,
+            "spans": len(tracer.spans),
+        },
+    }
+
+
+def write_chrome_trace(tracer, path: str) -> str:
+    """Serialize :func:`chrome_trace` to ``path`` (plain JSON — load in
+    the Perfetto UI or ``chrome://tracing``).  Returns ``path``."""
+    with open(path, "w") as f:
+        json.dump(chrome_trace(tracer), f)
+    return path

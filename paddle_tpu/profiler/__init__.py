@@ -98,6 +98,47 @@ def _register_fleet_metrics(m) -> None:
     _fleet_metrics.append(_weakref.ref(m))
 
 
+_flight_recorders: "list" = []
+
+
+def _register_flight_recorder(r) -> None:
+    _flight_recorders.append(_weakref.ref(r))
+
+
+def serving_flight_record() -> dict:
+    """Flight-recorder surface (ISSUE 9): for every engine that has one,
+    the bounded ring of recent step summaries plus any post-mortem
+    dumps frozen when ``health()`` flipped unhealthy or the fleet
+    ejected the replica.  Keyed by engine name; an ejected-and-rebuilt
+    replica's generations share its name, and the fleet's banked
+    ejection dumps (``FleetMetrics.flight_cb``) are merged in so a dump
+    survives its engine being discarded.  Returns
+    ``{engine_name: [snapshot_or_dump, ...]}`` (newest last)."""
+    out: dict = {}
+    seen_dumps = set()
+    live = []
+    for ref in _flight_recorders:
+        rec = ref()
+        if rec is None:
+            continue
+        live.append(ref)
+        snap = rec.snapshot()
+        for d in rec.dumps:
+            seen_dumps.add(id(d))
+        out.setdefault(rec.name, []).append(snap)
+    _flight_recorders[:] = live
+    for ref in _fleet_metrics:
+        m = ref()
+        if m is None or getattr(m, "flight_cb", None) is None:
+            continue
+        for name, dumps in m.flight_cb().items():
+            for d in dumps:
+                if id(d) not in seen_dumps:
+                    out.setdefault(name, []).append(
+                        {"name": name, "banked": True, "dumps": [d]})
+    return out
+
+
 def serving_fleet() -> dict:
     """Supervision snapshot of every live serving fleet, keyed by fleet
     name: per-replica occupancy/state table, dispatch + prefix-affinity

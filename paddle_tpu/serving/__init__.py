@@ -26,6 +26,15 @@ pressure (cheap resume via the prefix cache, stream restart from token
 ``retry_after_s``) — see docs/SERVING.md "Overload, priorities &
 preemption".
 
+Observability is per-request, not just aggregate: a no-op-by-default
+:class:`RequestTracer` records every request's span/event chain
+(submitted → queued → admitted → batched decode steps → retired, with
+linked preempt/resume, shed, and redispatch spans), the always-on
+bounded :class:`FlightRecorder` freezes the last N step summaries when
+an engine turns unhealthy or is ejected, and ``paddle_tpu.obs`` exports
+Perfetto/Chrome trace JSON, JSONL event logs, and a Prometheus-style
+text exposition — see docs/SERVING.md "Tracing & flight recorder".
+
 One level up, the fleet degrades per-replica, never per-fleet:
 :class:`Fleet` supervises N engine replicas behind one
 submit/stream/cancel surface — prefix-affinity dispatch, health-driven
@@ -42,6 +51,10 @@ from .paging import (  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
 from .sampling import SamplingParams, sample  # noqa: F401
 from .sanitize import SyncSanitizer  # noqa: F401
+from .tracing import (  # noqa: F401
+    FlightRecorder, NULL_TRACER, NullTracer, RequestTracer,
+    validate_trace,
+)
 from .metrics import ServingMetrics, FleetMetrics  # noqa: F401
 from .engine import (  # noqa: F401
     Engine, Request, QueueFull, ShedReject, EngineStopped,
@@ -55,4 +68,6 @@ __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "PRIORITY_LOW", "PRIORITY_NORMAL", "PRIORITY_HIGH",
            "BlockAllocator", "PagedKVCache", "PagedCacheContext",
            "PrefixCache", "AllocatorError",
-           "Fleet", "FleetRequest", "FleetMetrics", "SyncSanitizer"]
+           "Fleet", "FleetRequest", "FleetMetrics", "SyncSanitizer",
+           "RequestTracer", "NullTracer", "NULL_TRACER",
+           "FlightRecorder", "validate_trace"]

@@ -72,6 +72,7 @@ from .kv_cache import KVCache, CacheContext
 from .metrics import ServingMetrics
 from .sampling import SamplingParams, sample
 from .sanitize import SyncSanitizer
+from .tracing import NULL_TRACER, FlightRecorder, RequestTracer
 
 __all__ = ["Engine", "Request", "SamplingParams", "QueueFull",
            "ShedReject", "EngineStopped",
@@ -272,6 +273,16 @@ class Engine:
             lower classes (``None`` disables aging).  Aging affects
             queue *ordering* only; preemption rights always compare base
             priority classes, so equal-priority workloads never churn.
+        tracer: a :class:`~.tracing.RequestTracer` recording this
+            engine's per-request lifecycle span chain (share ONE tracer
+            across a fleet's replicas for the cross-replica story).
+            Default: the env-armed tracer (``PADDLE_TPU_TRACE=1``) or
+            the no-op :data:`~.tracing.NULL_TRACER` — tracing off costs
+            nothing on the decode hot path.
+        flight_recorder_steps: ring capacity of the always-on
+            :class:`~.tracing.FlightRecorder` (the last N step
+            summaries, dumped automatically when ``health()`` flips
+            unhealthy or the fleet ejects this replica).
     """
 
     def __init__(self, model, *, num_slots: int = 4,
@@ -291,7 +302,9 @@ class Engine:
                  enable_prefix_cache: bool = True,
                  prefix_lookup_timeout_s: float = 0.25,
                  max_preemptions: int = 2,
-                 priority_aging_s: Optional[float] = 5.0):
+                 priority_aging_s: Optional[float] = 5.0,
+                 tracer=None,
+                 flight_recorder_steps: int = 256):
         cfg = getattr(model, "config", None)
         if cfg is None:
             raise TypeError("Engine needs a model carrying a .config "
@@ -398,6 +411,14 @@ class Engine:
         # counts+attributes host transfers per decode step, =strict also
         # forbids d2h inside the compiled step; None = zero overhead
         self.sanitizer = SyncSanitizer.from_env()
+        # request-lifecycle tracer (docs/SERVING.md "Tracing & flight
+        # recorder"): host-side span/event chain per request, no-op by
+        # default; plus the always-on bounded flight recorder
+        if tracer is None:
+            tracer = RequestTracer.from_env() or NULL_TRACER
+        self.tracer = tracer
+        self.flight = FlightRecorder(flight_recorder_steps,
+                                     name=self.name)
         self.state = "active"    # active | draining | stopped | unhealthy
         self._unhealthy_reason: Optional[str] = None
         self._consecutive_failures = 0
@@ -499,6 +520,10 @@ class Engine:
             f"step watchdog fired: no step completion within "
             f"{self.step_timeout_s}s (stacks dumped to stderr)")
         self.state = "unhealthy"
+        # post-mortem: freeze the last-N-steps ring while it still shows
+        # the lead-up (safe from this thread — the scheduler is stalled)
+        self.flight.dump(self._unhealthy_reason)
+        self.tracer.on_unhealthy(self.name, self._unhealthy_reason)
 
     def _arm_watchdog(self) -> None:
         if self.step_timeout_s is None:
@@ -629,6 +654,7 @@ class Engine:
         req.state, req.error = "rejected", reason
         req.t_finish = time.perf_counter()
         self.metrics.on_reject()
+        self.tracer.on_retired(req, self.name, "rejected", reason)
 
     @staticmethod
     def _fresh_rng(req: Request) -> np.random.RandomState:
@@ -693,6 +719,7 @@ class Engine:
             req.error_ctx = {"depth": depth,
                              "retry_after_s": round(wait, 3)}
             self.metrics.on_shed()
+            self.tracer.on_shed(req, self.name, wait)
             self._reject(req, msg)
             err = ShedReject(msg, depth, retry_after_s=round(wait, 3))
             err.request = req
@@ -721,6 +748,7 @@ class Engine:
         req._engine = weakref.ref(self)
         self.queue.append(req)
         self.metrics.on_enqueue(len(self.queue))
+        self.tracer.on_queued(req, self.name)
         return req
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
@@ -928,6 +956,7 @@ class Engine:
         victim._rng = self._fresh_rng(victim)    # deterministic replay
         self.queue.append(victim)        # aging runs from its original
         self.metrics.on_preempt(len(self.queue))     # t_enqueue
+        self.tracer.on_preempt(victim, self.name)
 
     def _on_cancel(self, req: Request) -> None:
         """Queued requests leave immediately; running ones are retired at
@@ -1034,10 +1063,11 @@ class Engine:
 
     def _paged_prefill(self, req: Request, L: int):
         """Paged admission: prefix lookup, block assignment, tail-bucket
-        prefill.  Returns ``(status, last_logits, bucket)`` with status
-        ``"ok" | "deferred" | "failed"`` (``deferred`` = the pool cannot
-        supply the tail blocks right now and the slot was left untouched;
-        ``failed`` = the request was already retired)."""
+        prefill.  Returns ``(status, last_logits, bucket, prefix_hit)``
+        with status ``"ok" | "deferred" | "failed"`` (``deferred`` = the
+        pool cannot supply the tail blocks right now and the slot was
+        left untouched; ``failed`` = the request was already retired);
+        ``prefix_hit`` is the reused prefix length in tokens."""
         P, shared = self._prefix_lookup(req)
         bucket = self.bucket_for(L - P)
         # a PARTIAL hit can push prefix + padded tail past the slot's
@@ -1057,14 +1087,14 @@ class Engine:
             # raising lookups land here as P == 0, i.e. a plain miss
             self.prefix_cache.record_lookup(L, P)
         if not self.cache.begin_sequence(req.slot, shared, P, bucket):
-            return "deferred", None, bucket
+            return "deferred", None, bucket, P
         ids = np.zeros((1, bucket), dtype=np.int64)
         ids[0, :L - P] = req.prompt_ids[P:]
         last = self._prefill_call(
             req, to_tensor(ids), to_tensor(np.int32(req.slot)),
             to_tensor(np.int32(L)), to_tensor(np.int32(P)))
         if last is None:
-            return "failed", None, bucket
+            return "failed", None, bucket, P
         if self.prefix_cache is not None:
             # make this prompt's whole blocks hittable by later requests
             # (hit blocks are refreshed, new full tail blocks registered)
@@ -1073,7 +1103,7 @@ class Engine:
                     req.prompt_ids, self.cache.owned_blocks(req.slot))
             except Exception:            # noqa: BLE001 — isolation boundary
                 self.metrics.on_prefix_register_error()
-        return "ok", last, bucket
+        return "ok", last, bucket, P
 
     # tpulint: hot-path
     def _admit(self, req: Request) -> Optional[bool]:
@@ -1093,8 +1123,9 @@ class Engine:
             return None
         L = int(req.prompt_ids.size)
         t0 = time.perf_counter()
+        prefix_hit = 0
         if self.kv_layout == "paged":
-            status, last, bucket = self._paged_prefill(req, L)
+            status, last, bucket, prefix_hit = self._paged_prefill(req, L)
             if status == "deferred":
                 return False
             if status == "failed":
@@ -1116,6 +1147,8 @@ class Engine:
         req._seq_len = L
         self.running[req.slot] = req
         self.metrics.on_admit(bucket, L, len(self.queue))
+        self.tracer.on_admitted(req, self.name, bucket, req.slot,
+                                prefix_hit)
         try:
             tok = sample(logits, req.sampling, req._rng)
         except Exception as e:           # noqa: BLE001 — isolation boundary
@@ -1178,6 +1211,7 @@ class Engine:
             self.metrics.on_cancel()
         elif state == "failed":
             self.metrics.on_fail()
+        self.tracer.on_retired(req, self.name, state, req.error)
 
     def _mark_block_corruption(self, reason: str) -> None:
         """A block-accounting violation is engine-fatal for trust (not
@@ -1186,6 +1220,8 @@ class Engine:
         if self.state != "unhealthy":
             self.state = "unhealthy"
             self._unhealthy_reason = f"KV block accounting: {reason}"
+            self.flight.dump(self._unhealthy_reason)
+            self.tracer.on_unhealthy(self.name, self._unhealthy_reason)
 
     def _prepare_decode_paged(self) -> None:
         """Host-side block maintenance before a paged decode step: each
@@ -1202,6 +1238,9 @@ class Engine:
                     f"{type(e).__name__}: {e}")
                 ok = False
             if not ok:
+                self.tracer.on_block_pressure(req, self.name,
+                                              kind="pool_exhausted",
+                                              position=req._seq_len)
                 self._retire(req, "failed",
                              error="KV block pool exhausted: no block "
                                    f"free for position {req._seq_len} "
@@ -1260,6 +1299,11 @@ class Engine:
         logits = out.numpy()                     # [slots, V]
         now = time.perf_counter()
         self.metrics.on_decode_step(len(self.running), now - t0)
+        tr = self.tracer
+        if tr.enabled:
+            # ONE batched event per engine step, never one per token
+            tr.on_decode_step(self.name, self._step_counter,
+                              list(self.running), now - t0)
         for slot, req in list(self.running.items()):
             req._seq_len += 1                    # token written this step
             try:
@@ -1333,6 +1377,8 @@ class Engine:
                 self.free_slots.append(req.slot)
                 req.slot = None
                 req._defers += 1
+                self.tracer.on_block_pressure(req, self.name,
+                                              defers=req._defers)
                 victim = self._pick_victim(req)
                 if victim is not None:
                     self._preempt(victim)
@@ -1351,6 +1397,18 @@ class Engine:
             self._decode()
         self._step_counter += 1
         self._last_step_t = time.perf_counter()
+        # always-on flight recorder: one compact host-side summary per
+        # step into the bounded ring (the post-mortem tail)
+        if self.kv_layout == "paged":
+            self.flight.record(step=self._step_counter,
+                               running=len(self.running),
+                               queued=len(self.queue),
+                               free_blocks=self.cache.allocator
+                               .free_blocks)
+        else:
+            self.flight.record(step=self._step_counter,
+                               running=len(self.running),
+                               queued=len(self.queue))
         return bool(self.running or self.queue)
 
     def run(self, max_steps: Optional[int] = None) -> None:
@@ -1537,4 +1595,6 @@ class Engine:
         snap = self.metrics.snapshot()
         if self.sanitizer is not None:
             snap["sanitizer"] = self.sanitizer.report()
+        if self.tracer.enabled:
+            snap["tracing"] = self.tracer.snapshot()
         return snap

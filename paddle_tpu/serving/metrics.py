@@ -20,6 +20,7 @@ Each ``ServingMetrics`` registers itself with ``paddle_tpu.profiler`` so
 """
 from __future__ import annotations
 
+import copy
 import time
 from collections import deque
 from typing import Dict, Optional
@@ -209,9 +210,15 @@ class ServingMetrics:
 
     def snapshot(self) -> dict:
         """The ``/stats`` endpoint payload: one JSON-ready dict.  Latency
-        distributions cover the last ``_LATENCY_WINDOW`` samples."""
+        distributions cover the last ``_LATENCY_WINDOW`` samples.
+
+        **Copy-on-read guarantee** (ISSUE 9): the returned structure
+        shares NO mutable state with the engine — every nested dict and
+        list is deep-copied, so a caller mutating (or json-mangling) a
+        snapshot can never corrupt live counters, allocator gauges, or
+        a health/paging callback's backing store."""
         occ = self.occupancy()
-        return {
+        return copy.deepcopy({
             "name": self.name,
             "uptime_s": round(time.perf_counter() - self.t_start, 3),
             "requests": {
@@ -251,7 +258,7 @@ class ServingMetrics:
                 self.prefills_by_bucket.items())),
             "compile_cache": {"hits": self.compile_hits,
                               "misses": self.compile_misses},
-        }
+        })
 
 
 class FleetMetrics:
@@ -291,6 +298,10 @@ class FleetMetrics:
         self.total_recovery_s = 0.0
         # router-provided per-replica table (occupancy, state, queue)
         self.replicas_cb = None
+        # router-provided banked flight-recorder dumps, keyed by engine
+        # name — merged into profiler.serving_flight_record() so an
+        # ejected engine's post-mortem outlives the engine
+        self.flight_cb = None
         from .. import profiler as _profiler
 
         _profiler._register_fleet_metrics(self)
@@ -346,7 +357,10 @@ class FleetMetrics:
         return self.affinity_hits / routed if routed else 0.0
 
     def snapshot(self) -> dict:
-        return {
+        """JSON-ready fleet snapshot, deep-copied like
+        :meth:`ServingMetrics.snapshot` (copy-on-read: mutating it
+        cannot corrupt the fleet's live counters or replica table)."""
+        return copy.deepcopy({
             "name": self.name,
             "uptime_s": round(time.perf_counter() - self.t_start, 3),
             "requests": {
@@ -375,4 +389,4 @@ class FleetMetrics:
             },
             "replicas": (self.replicas_cb()
                          if self.replicas_cb is not None else None),
-        }
+        })

@@ -57,6 +57,7 @@ from .engine import (Engine, EngineStopped, PRIORITY_NORMAL, QueueFull,
                      Request, ShedReject, _as_priority)
 from .metrics import FleetMetrics
 from .sampling import SamplingParams
+from .tracing import NULL_TRACER, RequestTracer
 
 __all__ = ["Fleet", "FleetRequest"]
 
@@ -137,7 +138,8 @@ class _Replica:
     """One supervised engine slot in the fleet rotation."""
 
     __slots__ = ("index", "engine", "state", "ejections", "rebuilds",
-                 "rebuild_attempts", "last_error", "_eject_t")
+                 "rebuild_attempts", "last_error", "_eject_t",
+                 "flight_dumps")
 
     def __init__(self, index: int, engine: Engine):
         self.index = index
@@ -148,6 +150,10 @@ class _Replica:
         self.rebuild_attempts = 0        # consecutive failed rebuilds
         self.last_error: Optional[str] = None
         self._eject_t: Optional[float] = None
+        #: flight-recorder dumps banked at each ejection — the rebuild
+        #: record's post-mortem attachment (the ejected engine itself is
+        #: discarded, so the fleet keeps the dump alive)
+        self.flight_dumps: List[dict] = []
 
     def load(self) -> int:
         return len(self.engine.queue) + len(self.engine.running)
@@ -179,6 +185,12 @@ class Fleet:
             checks it through a replica-scoped view so
             ``serving.r<k>.<point>`` specs target exactly one replica
             (default: the env-armed plan).
+        tracer: a :class:`~.tracing.RequestTracer` shared by the router
+            and every replica engine — the fleet-wide request-lifecycle
+            span chain (docs/SERVING.md "Tracing & flight recorder").
+            Fleet-managed (rejected in ``engine_kwargs``); default: the
+            env-armed tracer (``PADDLE_TPU_TRACE=1``) or the no-op
+            tracer.
         **engine_kwargs: forwarded to every replica's ``Engine(...)``
             (``num_slots``, ``max_seq``, ``kv_layout``, ...).  ``name``
             and ``fault_plan`` are fleet-managed and rejected here.
@@ -188,7 +200,7 @@ class Fleet:
                  max_redispatch: int = 2, max_queue: Optional[int] = None,
                  eject_after_failures: int = 2, supervise_every: int = 1,
                  name: Optional[str] = None, fault_plan=None,
-                 **engine_kwargs):
+                 tracer=None, **engine_kwargs):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
@@ -198,7 +210,7 @@ class Fleet:
             raise ValueError("eject_after_failures must be >= 1")
         if supervise_every < 1:
             raise ValueError("supervise_every must be >= 1")
-        for k in ("name", "fault_plan"):
+        for k in ("name", "fault_plan", "tracer"):
             if k in engine_kwargs:
                 raise ValueError(f"{k!r} is fleet-managed; pass it to "
                                  "Fleet, not through engine kwargs")
@@ -216,12 +228,19 @@ class Fleet:
 
             fault_plan = ServingFaultPlan.from_env()
         self.fault_plan = fault_plan
+        # ONE tracer shared by the router and every replica generation:
+        # the cross-replica span chain (dispatch → attempt → redispatch)
+        # only links up when all parties record into the same tracer
+        if tracer is None:
+            tracer = RequestTracer.from_env() or NULL_TRACER
+        self.tracer = tracer
         self.replicas: List[_Replica] = [
             _Replica(k, self._make_engine(k))
             for k in range(self.num_replicas)]
         self.metrics = FleetMetrics(self.name,
                                     num_replicas=self.num_replicas)
         self.metrics.replicas_cb = self._replica_rows
+        self.metrics.flight_cb = self._flight_dump_table
         self.state = "active"            # active | draining | stopped
         #: live attempt → (fleet request, replica) — the reap table
         self._attempts: Dict[Request, Tuple[FleetRequest, _Replica]] = {}
@@ -245,7 +264,7 @@ class Fleet:
     def _make_engine(self, index: int) -> Engine:
         return Engine(self.model, name=f"{self.name}.r{index}",
                       fault_plan=self.fault_plan.scoped(index),
-                      **self._engine_kwargs)
+                      tracer=self.tracer, **self._engine_kwargs)
 
     def warmup(self) -> dict:
         """Warm every replica (pre-compile all buckets + decode per
@@ -310,7 +329,8 @@ class Fleet:
 
     def _dispatch(self, freq: FleetRequest,
                   exclude: Sequence[_Replica] = (),
-                  pin: Optional[int] = None) -> None:
+                  pin: Optional[int] = None,
+                  redispatch: bool = False) -> None:
         """Place ``freq`` on a replica (raises QueueFull/EngineStopped
         when the fleet genuinely cannot take it; ValueError only from
         enqueue-time validation, with the fleet handle rejected)."""
@@ -336,6 +356,10 @@ class Fleet:
                     raise EngineStopped(
                         f"fleet {self.name!r} has no active replica "
                         "to dispatch to")
+            # adoption window: the attempt span the engine creates
+            # inside this add_request joins the fleet trace, parented on
+            # the previous attempt (the redispatch chain) or the root
+            self.tracer.begin_attempt(freq, rep.engine.name)
             try:
                 ereq = rep.engine.add_request(
                     freq.prompt_ids, stream_cb=self._wrap_stream(freq),
@@ -355,11 +379,16 @@ class Fleet:
                 if pin is not None or not self._active(excluded):
                     raise
                 continue
+            finally:
+                self.tracer.end_attempt()
             freq._attempt = ereq
             freq.replica_history.append(rep.engine.name)
             self._attempts[ereq] = (freq, rep)
             self.metrics.on_dispatch(affinity_tokens=affinity,
                                      pinned=pin is not None)
+            self.tracer.on_dispatch(freq, rep.engine.name,
+                                    redispatch=redispatch,
+                                    affinity=affinity)
             return
 
     # -- public API --------------------------------------------------------
@@ -408,6 +437,7 @@ class Fleet:
                             kwargs=kwargs)
         freq.t_submit = time.perf_counter()
         freq._fleet = weakref.ref(self)
+        self.tracer.on_submitted(freq, self.name)
         try:
             # normalized for the backpressure estimate only — kwargs keep
             # the caller's value verbatim for redispatch
@@ -520,6 +550,7 @@ class Fleet:
         freq.t_finish = time.perf_counter()
         freq._attempt = None
         self.metrics.on_terminal(state)
+        self.tracer.on_fleet_terminal(freq, state, error)
         if freq.done_cb is not None:
             try:
                 freq.done_cb(freq)
@@ -595,7 +626,7 @@ class Fleet:
         freq.output_ids = []
         self.metrics.on_redispatch()
         try:
-            self._dispatch(freq, exclude=exclude)
+            self._dispatch(freq, exclude=exclude, redispatch=True)
         except (QueueFull, EngineStopped) as e:
             self._finish(freq, "failed",
                          error=f"redispatch found no replica: {e}; "
@@ -648,9 +679,15 @@ class Fleet:
         rep._eject_t = time.perf_counter()
         rep.last_error = reason
         # the engine leaves rotation: bank its preemption counter so
-        # the fleet aggregate survives the rebuild's fresh engine
+        # the fleet aggregate survives the rebuild's fresh engine, and
+        # freeze its flight recorder — the last-N-steps post-mortem is
+        # attached to the rebuild record and outlives the engine
         self._banked_preemptions += rep.engine.metrics.requests_preempted
+        rep.flight_dumps.append(
+            rep.engine.flight.dump(f"ejected: {reason}"))
+        del rep.flight_dumps[:-8]        # bounded: keep the newest 8
         self.metrics.on_eject()
+        self.tracer.on_eject(rep.engine.name, reason)
         err = f"replica {rep.engine.name!r} ejected: {reason}"
         orphans = []
         for ereq in rep.engine.export_requests():
@@ -691,6 +728,7 @@ class Fleet:
                               f"{self.MAX_REBUILD_ATTEMPTS}): "
                               f"{type(e).__name__}: {e}")
             self.metrics.on_rebuild(0.0, ok=False)
+            self.tracer.on_rebuild(rep.engine.name, 0.0, ok=False)
             return
         rep.engine = eng
         rep.state = "active"
@@ -700,6 +738,7 @@ class Fleet:
                                           time.perf_counter())
         rep._eject_t = None
         self.metrics.on_rebuild(recovery)
+        self.tracer.on_rebuild(eng.name, recovery)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -784,8 +823,27 @@ class Fleet:
                 "compile_misses": m.compile_misses,
                 "preemptions": m.requests_preempted,
                 "shed": m.requests_shed,
+                # the rebuild record's post-mortem attachment: a summary
+                # of the flight dump frozen at the last ejection (the
+                # full dump rides profiler.serving_flight_record())
+                "last_flight_record": (
+                    {"reason": rep.flight_dumps[-1]["reason"],
+                     "steps_seen": rep.flight_dumps[-1]["steps_seen"],
+                     "events": len(rep.flight_dumps[-1]["events"])}
+                    if rep.flight_dumps else None),
             })
         return rows
+
+    def _flight_dump_table(self) -> Dict[str, List[dict]]:
+        """Banked ejection dumps per engine name — merged into
+        ``profiler.serving_flight_record()`` so a dump survives its
+        (discarded) engine."""
+        out: Dict[str, List[dict]] = {}
+        for rep in self.replicas:
+            if rep.flight_dumps:
+                out.setdefault(rep.engine.name, []).extend(
+                    rep.flight_dumps)
+        return out
 
     def _overload_section(self) -> dict:
         """Fleet-wide overload totals: preemptions are per-engine events
@@ -822,6 +880,8 @@ class Fleet:
         out["state"] = self.state
         out["pending"] = self.pending
         out["overload"] = self._overload_section()
+        if self.tracer.enabled:
+            out["tracing"] = self.tracer.snapshot()
         out["engines"] = {rep.engine.name: rep.engine.stats()
                           for rep in self.replicas}
         return out

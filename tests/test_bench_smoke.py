@@ -74,6 +74,13 @@ def test_serving_mode_emits_json_line():
     assert out["serving_shed"] >= 1
     assert out["serving_high_ttft_p99_ms"] < \
         out["serving_baseline_high_ttft_p99_ms"]
+    # request-lifecycle tracing (ISSUE 9): the measured trace-replay run
+    # recorded a span chain, the chain validator passed (1.0 = every
+    # request terminal exactly once with preempt links intact and a
+    # well-formed Perfetto export), and — via the zero-compile-miss
+    # gates above — the traced run added no steady-state compiles
+    assert out["serving_trace_events"] > 0
+    assert out["serving_trace_valid"] == 1.0
 
 
 def test_preflight_failure_is_structured():

@@ -2,11 +2,12 @@
 dispatch, redispatch budget, exactly-once terminals (ISSUE 6).
 
 The acceptance scenario (a scoped fault plan killing 1 of 3 replicas
-mid-decode) runs ONCE in a module-scope fixture; the assertions ride in
-separate tests and later tests reuse the healed fleet, so the file pays
-for four engine warmups total.  No test here may be marked ``slow`` —
-tools/collect_gate.py fails CI if fleet coverage would drop out of
-tier-1.
+mid-decode) runs ONCE in the session-scope ``fleet_chaos`` fixture
+(tests/conftest.py, shared with test_tracing.py's trace-chain
+validation); the assertions ride in separate tests and later tests
+reuse the healed fleet, so the file pays for four engine warmups total.
+No test here may be marked ``slow`` — tools/collect_gate.py fails CI if
+fleet coverage would drop out of tier-1.
 """
 import json
 
@@ -17,18 +18,17 @@ import paddle_tpu as paddle
 from paddle_tpu.distributed.fault_tolerance import (
     InjectedFault, ServingFaultPlan,
 )
-from paddle_tpu.models import GPTForCausalLM, gpt_tiny
 from paddle_tpu.serving import (
     EngineStopped, Fleet, FleetRequest, QueueFull,
 )
 
 
 @pytest.fixture(scope="module")
-def gpt():
-    paddle.seed(0)
-    m = GPTForCausalLM(gpt_tiny())
-    m.eval()
-    return m
+def gpt(serving_model):
+    """The session-shared tiny GPT (tests/conftest.py) — ISSUE 9 moved
+    it up so test_tracing.py can validate the SAME chaos run without
+    paying for a second fleet."""
+    return serving_model
 
 
 def _full_logits(model, seq):
@@ -95,44 +95,18 @@ class TestScopedFaultPlan:
 
 
 # -- the acceptance scenario: kill 1 of 3 replicas mid-decode --------------
+# The scenario itself now runs ONCE per session in tests/conftest.py
+# (``fleet_chaos``) with a RequestTracer attached, shared with
+# test_tracing.py's chain validation; this module asserts the failover
+# semantics on that same run.
 
-N_CHAOS = 6          # requests in flight when replica 1 dies
-MAX_NEW = 4
+MAX_NEW = 4          # kept in lockstep with conftest.fleet_chaos
 
 
 @pytest.fixture(scope="module")
-def chaos(gpt):
-    """Run the ISSUE 6 chaos scenario once: a 3-replica paged fleet, a
-    scoped fault plan killing replica 1's decode (both retry attempts)
-    mid-stream, supervision ejecting + rebuilding it.  Returns the
-    healed fleet plus the run's artifacts for the assertion tests."""
-    plan = ServingFaultPlan().add("serving.r1.decode", at_call=2, times=2)
-    fleet = Fleet(gpt, num_replicas=3, num_slots=2, max_seq=32,
-                  min_bucket=16, kv_layout="paged", block_size=16,
-                  eject_after_failures=2, max_redispatch=2,
-                  fault_plan=plan)
-    fleet.warmup()
-    warm = {rep.engine.name: rep.engine.metrics.compile_misses
-            for rep in fleet.replicas}
-    original_r1 = fleet.replicas[1].engine
-    rs = np.random.RandomState(3)
-    prompts = [rs.randint(0, 128, (L,)).tolist()
-               for L in (5, 9, 4, 7, 11, 3)]
-    terminals, streamed = [], []
-    reqs = []
-    for i, p in enumerate(prompts):
-        reqs.append(fleet.submit(
-            p, max_new_tokens=MAX_NEW,
-            # the first two are pinned onto the doomed replica so it is
-            # guaranteed to hold in-flight streams when the fault fires
-            replica=1 if i < 2 else None,
-            stream_cb=lambda t, r: streamed.append(
-                (r.request_id, r.redispatches, t)),
-            done_cb=lambda r: terminals.append(r.request_id)))
-    fleet.run()
-    return {"fleet": fleet, "prompts": prompts, "reqs": reqs,
-            "terminals": terminals, "streamed": streamed, "warm": warm,
-            "original_r1": original_r1}
+def chaos(fleet_chaos):
+    assert fleet_chaos["max_new"] == MAX_NEW
+    return fleet_chaos
 
 
 class TestFleetChaos:

@@ -144,6 +144,7 @@ TIER1_CRITICAL = {
     "tests/test_paging.py": "the KV block allocator",
     "tests/test_fleet.py": "fleet supervision/failover",
     "tests/test_overload.py": "priority/preemption/shed scheduling",
+    "tests/test_tracing.py": "request-lifecycle tracing/flight recorder",
 }
 
 
