@@ -344,6 +344,54 @@ def _trace_replay(model):
     }
 
 
+def _paged_kernel_microbench(model):
+    """Paged-kernel vs reference-gather decode microbench (ISSUE 11):
+    the same decode-heavy workload through two paged engines that differ
+    ONLY in the attention path — ``kernel="pallas"`` (block table
+    consumed inside the flash-decoding kernel) vs ``kernel="reference"``
+    (jnp gather + masked softmax).  Greedy outputs must agree bitwise
+    and both runs must stay at zero steady-state compile misses; the
+    throughput ratio is emitted as ``serving_paged_kernel_speedup`` so
+    the trajectory is tracked even off-TPU (in Pallas interpret mode the
+    kernel pays an interpreter tax the XLA-native gather doesn't — the
+    ratio is the number to watch when the TPU tunnel returns, where the
+    kernel additionally skips the materialized contiguous K/V copy)."""
+    import numpy as np
+    from paddle_tpu.serving import Engine
+
+    FAIL_METRIC = "serving_gpt_tiny_decode_tokens_per_sec"
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, 128, (L,)).tolist() for L in (9, 17, 30, 5)]
+    tps, outs = {}, {}
+    for kern in ("pallas", "reference"):
+        eng = Engine(model, num_slots=4, max_seq=64, min_bucket=8,
+                     kv_layout="paged", block_size=8, kernel=kern)
+        eng.warmup()
+        eng.generate(prompts, max_new_tokens=4)     # prime steady state
+        reqs = [eng.add_request(p, max_new_tokens=24) for p in prompts]
+        eng.run()
+        st = eng.stats()
+        if st["compile_cache"]["misses"] != len(eng.buckets) + 1:
+            fail_structured(
+                f"paged {kern} kernel path recompiled in steady state: "
+                f"{st['compile_cache']}", metric=FAIL_METRIC)
+        if any(not r.finished for r in reqs):
+            fail_structured(f"paged {kern} microbench left unfinished "
+                            "requests", metric=FAIL_METRIC)
+        outs[kern] = [r.output_ids for r in reqs]
+        tps[kern] = st["decode_tokens_per_sec"]
+    if outs["pallas"] != outs["reference"]:
+        fail_structured("paged kernel greedy outputs diverge from the "
+                        "reference-gather path", metric=FAIL_METRIC)
+    return {
+        "serving_paged_kernel_tokens_per_sec": round(tps["pallas"], 2),
+        "serving_paged_reference_tokens_per_sec":
+            round(tps["reference"], 2),
+        "serving_paged_kernel_speedup":
+            round(tps["pallas"] / max(tps["reference"], 1e-9), 4),
+    }
+
+
 def serving_main():
     """Serving smoke bench: continuous-batching decode throughput + TTFT
     on the tiny GPT config (ISSUE 3).  Same one-JSON-line contract as the
@@ -475,6 +523,9 @@ def serving_main():
     fleet_tokens = sum(len(r.output_ids) for r in f_reqs)
     fleet.shutdown(timeout_s=0.0)
 
+    # -- paged-kernel vs reference-gather decode microbench --------------
+    kernel_bench = _paged_kernel_microbench(model)
+
     # -- overload trace-replay: priorities vs the no-priority baseline ---
     trace = _trace_replay(model)
 
@@ -503,10 +554,18 @@ def serving_main():
         "step_retries": fl["step_retries"],
         "engine_state": st["health"]["state"],
         # per-decode-step device→host transfer count measured by the
-        # sync-point sanitizer (ISSUE 7) — the ROADMAP item-2 "before"
-        # number (currently 1.0: the host-side sampling logits pull)
+        # sync-point sanitizer (ISSUE 7) — 0.0 since ISSUE 11 moved
+        # sampling on-device (the PR 7 baseline was 1.0: the host-side
+        # sampling logits pull; the decode dispatch now performs no
+        # blocking host transfer, and the stream-delivery token pull
+        # happens outside the sanitizer window by design)
         "serving_decode_host_transfers":
             st["sanitizer"]["per_decode_step"],
+        # paged-kernel vs reference-gather decode microbench (ISSUE 11):
+        # bitwise-equal greedy outputs enforced; the speedup ratio
+        # tracks the Pallas flash-decoding path against the jnp gather
+        # oracle (interpret-mode number off-TPU)
+        **kernel_bench,
         # paged KV + prefix reuse (ISSUE 5): the shared-prefix workload
         # through both layouts — hit rate must be > 0, and the paged
         # TTFT reflects prefilling only the uncached tail bucket

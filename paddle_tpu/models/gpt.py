@@ -38,7 +38,6 @@ from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.norm import LayerNorm
 from ..ops.pallas import flash_attention as _flash_attention
-from ..ops.cached_attention import cached_attention as _cached_attention
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
@@ -133,8 +132,10 @@ class GPTAttention(Layer):
             cache_ctx.write_prefill(k, v)
             ctx = cache_ctx.prefill_attention(q, k, v)
         else:                                   # decode: S == 1 per slot
-            k_full, v_full, lens = cache_ctx.write_decode(k, v)
-            ctx = _cached_attention(q, k_full, v_full, lens)
+            # write + attend routed through the context: the paged cache
+            # may stream blocks through the Pallas flash-decoding kernel
+            # instead of gathering a contiguous copy (ROADMAP item 2)
+            ctx = cache_ctx.decode_attention(q, k, v)
         ctx = mark_sharding(ctx, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
         ctx = ctx.reshape([B, S, self.n_heads * self.head_dim])
         return self.out_proj(ctx)

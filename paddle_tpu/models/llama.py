@@ -40,7 +40,6 @@ from ..nn.layer.container import LayerList
 from ..nn.layer.norm import RMSNorm
 from ..ops.pallas import flash_attention as _flash_attention
 from ..ops.pallas import rotary_embedding as _rotary_embedding
-from ..ops.cached_attention import cached_attention as _cached_attention
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
 )
@@ -181,9 +180,10 @@ class LlamaAttention(Layer):
             sin = Tensor._wrap(jnp.asarray(self._rope[1]))
             q, k = _rotary_embedding(q, k, cos, sin,
                                      position_ids=cache_ctx.positions())
-            # cache stores post-rotary K (and V) at kv-head granularity
-            k_full, v_full, lens = cache_ctx.write_decode(k, v)
-            ctx = _cached_attention(q, k_full, v_full, lens)
+            # cache stores post-rotary K (and V) at kv-head granularity;
+            # write + attend routed through the context (the paged cache
+            # may run the Pallas flash-decoding kernel over its blocks)
+            ctx = cache_ctx.decode_attention(q, k, v)
         else:
             pos = None if cache_ctx is None else \
                 cache_ctx.prefill_positions(S)

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from ..core.dispatch import apply_op
 
 __all__ = ["cached_attention", "gather_block_kv",
-           "block_prefill_attention"]
+           "block_prefill_attention", "paged_decode_attention",
+           "paged_prefill_attention"]
 
 
 def cached_attention(query, k_cache, v_cache, lengths, name=None):
@@ -129,3 +130,63 @@ def block_prefill_attention(query, k_cache, v_cache, start, name=None):
 
     return apply_op("block_prefill_attention", _primal,
                     [query, k_cache, v_cache, start])
+
+
+def paged_decode_attention(query, k_pool, v_pool, block_tables, lengths,
+                           interpret=False, name=None):
+    """Flash-decoding paged attention: the Pallas kernel path of the
+    decode read (``ops.pallas.paged_attention_kernel``), consuming the
+    block table *inside* the kernel — the fused replacement for
+    ``gather_block_kv`` + :func:`cached_attention` (which remain the
+    ``kernel="reference"`` oracle).
+
+    Args:
+        query:        ``[B, 1, H, D]`` current-token queries.
+        k_pool:       ``[num_blocks, block_size, Hkv, D]`` one layer of
+                      the paged key pool (current token already written).
+        v_pool:       same for values.
+        block_tables: ``[B, max_blocks]`` int32 per-slot block ids.
+        lengths:      ``[B]`` int32 current token index per slot.
+        interpret:    run the kernel in Pallas interpret mode (the
+                      CPU/tier-1 path; False compiles for real TPUs).
+
+    Returns:
+        ``[B, 1, H, D]`` context, GQA expanded inside the kernel.
+    """
+    from .pallas.paged_attention_kernel import paged_decode_attention_kernel
+
+    def _primal(q, kp, vp, tbl, ln):
+        return paged_decode_attention_kernel(q, kp, vp, tbl, ln,
+                                             interpret=interpret)
+
+    return apply_op("paged_decode_attention", _primal,
+                    [query, k_pool, v_pool, block_tables, lengths])
+
+
+def paged_prefill_attention(query, k_pool, v_pool, block_row, start,
+                            interpret=False, name=None):
+    """Fused cached-prefix + causal-tail prefill attention: the Pallas
+    kernel path of the paged tail prefill, streaming the slot's block
+    row straight off the pool — the fused replacement for
+    ``gather_block_kv`` + :func:`block_prefill_attention`.
+
+    Args:
+        query:     ``[1, S, H, D]`` tail queries (S = tail bucket).
+        k_pool:    ``[num_blocks, block_size, Hkv, D]`` layer key pool.
+        v_pool:    same for values.
+        block_row: ``[max_blocks]`` int32 — the slot's block-table row.
+        start:     scalar int32 — absolute position of the first query.
+        interpret: Pallas interpret mode (CPU/tier-1 path).
+
+    Returns:
+        ``[1, S, H, D]`` context.
+    """
+    from .pallas.paged_attention_kernel import paged_prefill_attention_kernel
+
+    def _primal(q, kp, vp, row, st):
+        return paged_prefill_attention_kernel(
+            q, kp, vp, row, jnp.asarray(st).reshape(1),
+            interpret=interpret)
+
+    return apply_op("paged_prefill_attention", _primal,
+                    [query, k_pool, v_pool, block_row, start])

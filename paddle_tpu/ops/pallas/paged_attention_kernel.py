@@ -1,0 +1,264 @@
+"""Pallas TPU paged attention: block-table-consuming decode + fused
+cached-prefix/causal-tail prefill kernels for the paged serving path.
+
+Reference parity: the jnp formulation in ``ops.cached_attention``
+(``gather_block_kv`` + ``cached_attention`` for decode,
+``gather_block_kv`` + ``block_prefill_attention`` for tail prefill) —
+re-designed flash-decoding style (FlashFuser, arXiv:2512.12949: one
+kernel scope over the cached prefix and the causal tail) so the block
+table is consumed *inside* the kernel instead of first materializing a
+contiguous ``[slots, max_blocks * block_size, Hkv, D]`` copy of every
+slot's K/V in HBM:
+
+- **decode** (``paged_decode_attention``): grid ``(slots, max_blocks)``;
+  the block table and lengths ride in scalar-prefetch SMEM, and each
+  grid step DMAs exactly ONE ``[block_size, Hkv, D]`` K/V block —
+  selected by the table *value*, the automatic-kernel-generation move of
+  arXiv:2006.12645 (the index map is data-driven, the kernel is not
+  specialized per table) — accumulating an online softmax per query
+  head.  GQA stays inside the kernel (kv head ``h // (H // Hkv)`` serves
+  query head ``h``, repeated consecutively like the jnp oracle).
+- **prefill** (``paged_prefill_attention``): the tail bucket's S queries
+  attend over the slot's whole block row (shared prefix blocks + the
+  freshly written tail) in one kernel scope, streaming key blocks with
+  an absolute-position causal mask ``kpos <= start + s`` — the fused
+  replacement for the gather + two-phase mask of
+  ``block_prefill_attention``.
+
+Both kernels run under ``interpret=True`` off-TPU so the CPU tier-1
+suite executes the exact kernel code path; shapes depend only on
+``(slots, block_size, max_blocks, heads, head_dim)`` — block ids and
+lengths are *values*, so the serving engine's zero-recompile discipline
+holds unchanged.  All accumulation is f32 (matching the oracle's f32
+softmax); parity vs the jnp path is ~1e-6, asserted in
+tests/test_paged_kernel.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...core.jax_compat import pallas_tpu_compiler_params
+
+_CompilerParams = pallas_tpu_compiler_params()
+
+NEG_INF = -1e30
+
+
+def _expand_gqa(kv, n_heads: int):
+    """``[BS, Hkv, D] -> [BS, H, D]``: repeat kv heads consecutively so
+    kv head ``h // (H // Hkv)`` serves query head ``h`` — bit-identical
+    to the jnp oracle's ``jnp.repeat(k, rep, axis=2)``."""
+    hkv = kv.shape[1]
+    if hkv == n_heads:
+        return kv
+    return jnp.repeat(kv, n_heads // hkv, axis=1)
+
+
+# -- decode: one query token per slot, K/V streamed by block table ----------
+
+def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, block_size):
+    b, i = pl.program_id(0), pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]                          # current token index
+    # a block is live iff it intersects the valid window 0..length
+    # (blocks past the sequence are skipped — their DMA still resolves,
+    # to whatever the table row holds, but nothing is accumulated)
+    live = i * block_size <= length
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)      # [H, D]
+        k = _expand_gqa(k_ref[0], q.shape[0]).astype(jnp.float32)
+        v = _expand_gqa(v_ref[0], q.shape[0]).astype(jnp.float32)
+        s = jnp.einsum("hd,jhd->hj", q, k,
+                       preferred_element_type=jnp.float32) * scale  # [H,BS]
+        pos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)               # [H, BS]
+        s = jnp.where(pos <= length, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]                   # [H, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                   # [H, BS]
+        corr = jnp.exp(m_prev - m_new)           # [H, 1]
+        l_new = l_ref[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jnp.einsum("hj,jhd->hd", p, v,
+                        preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == nb - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)          # unreachable: pos 0 valid
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention_kernel(q, k_pool, v_pool, block_tables,
+                                  lengths, *, interpret=False):
+    """One decode step of attention straight off the block pool.
+
+    Args:
+        q:            ``[B, 1, H, D]`` current-token queries.
+        k_pool:       ``[num_blocks, block_size, Hkv, D]`` one layer of
+                      the paged key pool (current token already written).
+        v_pool:       same for values.
+        block_tables: ``[B, max_blocks]`` int32 block ids per slot.
+        lengths:      ``[B]`` int32 current token index per slot
+                      (attention window ``0..lengths[b]`` inclusive).
+
+    Returns:
+        ``[B, 1, H, D]`` context.  No contiguous K/V copy is ever
+        materialized: each grid step reads one pool block by table value.
+    """
+    B, _, H, D = q.shape
+    block_size = k_pool.shape[1]
+    MB = block_tables.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               block_size=block_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MB),
+        in_specs=[
+            pl.BlockSpec((1, 1, H, D), lambda b, i, tbl, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, block_size) + k_pool.shape[2:],
+                         lambda b, i, tbl, lens: (tbl[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, block_size) + v_pool.shape[2:],
+                         lambda b, i, tbl, lens: (tbl[b, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H, D),
+                               lambda b, i, tbl, lens: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+# -- fused prefill: cached prefix + causal tail in one kernel scope ---------
+
+def _prefill_kernel(row_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                    acc_ref, m_ref, l_ref, *, scale, block_size):
+    i = pl.program_id(0)
+    nb = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    start = start_ref[0]
+    S, H = q_ref.shape[1], q_ref.shape[2]
+    # the last live key position is the last query's absolute position;
+    # blocks wholly past it contribute nothing (pure prefix blocks below
+    # `start` are always live — that's the fused cross-attention half)
+    live = i * block_size <= start + S - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)         # [S, H, D]
+        k = _expand_gqa(k_ref[0], H).astype(jnp.float32)   # [BS, H, D]
+        v = _expand_gqa(v_ref[0], H).astype(jnp.float32)
+        s = jnp.einsum("shd,jhd->shj", q, k,
+                       preferred_element_type=jnp.float32) * scale  # [S,H,BS]
+        qpos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        s = jnp.where(kpos <= qpos, s, NEG_INF)  # abs-position causal mask
+        m_prev = m_ref[:]                        # [S, H]
+        m_cur = jnp.max(s, axis=2)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, :, None])       # [S, H, BS]
+        corr = jnp.exp(m_prev - m_new)           # [S, H]
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=2)
+        pv = jnp.einsum("shj,jhd->shd", p, v,
+                        preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr[:, :, None] + pv
+        m_ref[:] = m_new
+
+    @pl.when(i == nb - 1)
+    def _finalize():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
+
+
+def paged_prefill_attention_kernel(q, k_pool, v_pool, block_row, start,
+                                   *, interpret=False):
+    """Fused tail-bucket prefill attention straight off the block pool.
+
+    The tail's S queries (absolute positions ``start..start+S-1``)
+    attend over the slot's whole block row — cached prefix blocks and
+    the freshly written tail — under one absolute-position causal mask,
+    streamed block by block with an online softmax (no gathered
+    contiguous K/V copy, no second masking phase).
+
+    Args:
+        q:         ``[1, S, H, D]`` tail queries.
+        k_pool:    ``[num_blocks, block_size, Hkv, D]`` layer key pool.
+        v_pool:    same for values.
+        block_row: ``[max_blocks]`` int32 — the slot's block-table row.
+        start:     ``[1]`` int32 — absolute position of the first query
+                   (== cached prefix length, a block boundary).
+
+    Returns:
+        ``[1, S, H, D]`` context.
+    """
+    _, S, H, D = q.shape
+    block_size = k_pool.shape[1]
+    MB = block_row.shape[0]
+    scale = 1.0 / (D ** 0.5)
+    kernel = functools.partial(_prefill_kernel, scale=scale,
+                               block_size=block_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(MB,),
+        in_specs=[
+            pl.BlockSpec((1, S, H, D), lambda i, row, st: (0, 0, 0, 0)),
+            pl.BlockSpec((1, block_size) + k_pool.shape[2:],
+                         lambda i, row, st: (row[i], 0, 0, 0)),
+            pl.BlockSpec((1, block_size) + v_pool.shape[2:],
+                         lambda i, row, st: (row[i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, S, H, D),
+                               lambda i, row, st: (0, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((S, H, D), jnp.float32),
+            pltpu.VMEM((S, H), jnp.float32),
+            pltpu.VMEM((S, H), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, S, H, D), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(block_row.astype(jnp.int32),
+      jnp.asarray(start, dtype=jnp.int32).reshape(1),
+      q, k_pool, v_pool)

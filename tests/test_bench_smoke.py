@@ -44,11 +44,22 @@ def test_serving_mode_emits_json_line():
               "deadline_expired", "step_retries"):
         assert out[k] == 0, (k, out)
     assert out["engine_state"] == "active"
-    # sync-point sanitizer baseline (ISSUE 7): exactly ONE device→host
-    # transfer per decode step — the suppressed host-side sampling
-    # logits pull.  ROADMAP item 2 drives this to 0; any OTHER value
-    # means a sync crept into (or silently left) the decode hot path
-    assert out["serving_decode_host_transfers"] == 1.0, out
+    # sync-point sanitizer (ISSUE 7 baseline: 1.0 — the host-side
+    # sampling logits pull).  ISSUE 11 moved sampling on-device: the
+    # decode dispatch performs ZERO blocking host transfers, measured
+    # with the sanitizer armed.  Any other value means a sync crept
+    # back into the decode hot path
+    assert out["serving_decode_host_transfers"] == 0.0, out
+    # paged-kernel vs reference-gather decode microbench (ISSUE 11):
+    # both paths ran at zero steady-state misses with bitwise-equal
+    # greedy outputs (bench exits nonzero otherwise); the speedup ratio
+    # is the tracked trajectory — in CPU interpret mode the Pallas
+    # kernel pays an interpreter tax, so only positivity is pinned
+    # here (>= 1 is the on-TPU expectation, where the kernel also skips
+    # the materialized contiguous K/V gather)
+    assert out["serving_paged_kernel_tokens_per_sec"] > 0
+    assert out["serving_paged_reference_tokens_per_sec"] > 0
+    assert out["serving_paged_kernel_speedup"] > 0
     # paged KV + prefix reuse (ISSUE 5): the shared-prefix workload must
     # actually hit the cache, and both layouts report TTFT side by side
     assert out["serving_prefix_hit_rate"] > 0

@@ -310,6 +310,39 @@ class TestPreemption:
         assert vic.output_ids == ref.output_ids
         assert eng.metrics.compile_misses == warm
 
+    def test_device_key_state_resume_top_k_top_p(self, gpt, peng):
+        """ISSUE 11 extension of the bitwise resume contract: sampling
+        now runs ON DEVICE (per-slot jax.random key lanes in the
+        compiled step), and a preempted request's resume re-seeds its
+        key lane from the request seed at re-admission — so the full
+        top-k/top-p seeded restriction replays bitwise too, not just
+        plain temperature."""
+        eng = peng
+        warm = eng.metrics.compile_misses
+        rs = np.random.RandomState(16)
+        p = rs.randint(0, 128, (6,)).tolist()
+        sp = dict(temperature=0.8, top_k=10, top_p=0.9, seed=314)
+        ref = eng.add_request(p, max_new_tokens=6,
+                              sampling=SamplingParams(**sp))
+        eng.run()                        # uninterrupted seeded reference
+        assert ref.finished
+        vic = eng.add_request(p, max_new_tokens=6,
+                              sampling=SamplingParams(**sp),
+                              priority="low")
+        filler = eng.add_request(rs.randint(0, 128, (5,)).tolist(),
+                                 max_new_tokens=6, priority="low")
+        eng.step()
+        eng.step()
+        hi = eng.add_request(rs.randint(0, 128, (3,)).tolist(),
+                             max_new_tokens=3, priority="high")
+        eng.run()
+        assert vic.preempted or filler.preempted
+        assert all(r.finished for r in (vic, filler, hi))
+        assert vic.output_ids == ref.output_ids
+        # on-device restriction actually bit: everything stays in-vocab
+        assert all(0 <= t < 128 for t in vic.output_ids)
+        assert eng.metrics.compile_misses == warm
+
     def test_preempt_for_blocks_cheap_resume(self, gpt):
         """The block-pool half of the tentpole: a high-priority
         admission the pool cannot serve evicts the low-priority victim's

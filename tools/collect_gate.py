@@ -145,6 +145,10 @@ TIER1_CRITICAL = {
     "tests/test_fleet.py": "fleet supervision/failover",
     "tests/test_overload.py": "priority/preemption/shed scheduling",
     "tests/test_tracing.py": "request-lifecycle tracing/flight recorder",
+    "tests/test_paged_kernel.py":
+        "Pallas paged-attention kernel parity vs the jnp reference",
+    "tests/test_device_sampling.py":
+        "on-device sampling parity vs the host oracle",
 }
 
 

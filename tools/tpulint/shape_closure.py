@@ -141,13 +141,15 @@ def _prefill_args(eng, bucket: int, *, L: int = 1, slot: int = 0,
 
 
 def _decode_args(eng, *, n_active: int = 0):
+    """Decode takes ONLY the active mask since on-device sampling: the
+    input token ids live in the engine's device-side token lane
+    (``Engine.sampler.tokens``), lifted state rather than an argument."""
     import numpy as np
     from paddle_tpu.core.tensor import to_tensor
 
-    toks = np.zeros((eng.num_slots, 1), dtype=np.int64)
     active = np.zeros((eng.num_slots,), dtype=np.int32)
     active[:n_active] = 1
-    return [to_tensor(toks), to_tensor(active)]
+    return [to_tensor(active)]
 
 
 def enumerate_config(kv_layout: str, cfg: dict) -> Tuple[dict, dict]:
