@@ -594,6 +594,73 @@ def serving_main():
     }))
 
 
+def _train_rollback_drill():
+    """Divergence-sentry rollback drill (ISSUE 12): a tiny compiled
+    train loop under ``ResilientLoop`` with an injected transient NaN
+    (``train.nan`` fault point).  The in-graph sentry must latch, roll
+    back to the memory-snapshot ring, and skip the window — the drill
+    fails structured otherwise — and emits the measured restore time as
+    ``train_rollback_recovery_ms`` plus the sentry counters (pinned in
+    tests/test_bench_smoke.py).  Runs the exact recovery path a 13B
+    multi-chip job would take, at toy scale."""
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fault_tolerance import (
+        DivergenceSentry, FaultPlan, ResilientLoop, global_grad_norm)
+
+    paddle.seed(7)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    sentry = DivergenceSentry(window=8, min_history=2, spike_factor=8.0,
+                              grad_ratio=100.0, snapshot_every=2,
+                              ring_capacity=2, max_rollbacks=2)
+    plan = FaultPlan().add_train_fault("train.nan", 5)
+
+    @paddle.jit.to_static
+    def train_step(x):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        sentry.observe(loss, grad_norm=global_grad_norm(net.parameters()))
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def step_fn(step):
+        rs = np.random.RandomState(100 + step)
+        x = plan.corrupt_batch(step, rs.randn(4, 8).astype(np.float32))
+        train_step(paddle.to_tensor(x))
+
+    with tempfile.TemporaryDirectory(prefix="bench_sentry_") as ckdir:
+        loop = ResilientLoop(
+            ckdir,
+            state_fn=lambda: {"model": net.state_dict(),
+                              "opt": opt.state_dict()},
+            restore_fn=lambda s: (net.set_state_dict(s["model"]),
+                                  opt.set_state_dict(s["opt"])),
+            save_every=None, save_final=False, sentry=sentry,
+            verbose=False)
+        loop.run(step_fn, 8)
+    if sentry.rollbacks < 1 or sentry.anomalies < 1 \
+            or loop.last_rollback_recovery_s is None:
+        fail_structured(
+            f"sentry rollback drill did not recover as scripted: "
+            f"{loop.sentry_stats()}")
+    final = np.asarray(net.state_dict()["weight"].numpy())
+    if not np.isfinite(final).all():
+        fail_structured("sentry rollback drill left non-finite weights")
+    return {
+        "train_rollback_recovery_ms": round(
+            loop.last_rollback_recovery_s * 1e3, 3),
+        "train_sentry_anomalies": sentry.anomalies,
+        "train_sentry_rollbacks": sentry.rollbacks,
+        "train_sentry_skipped_steps": sentry.skipped_steps,
+    }
+
+
 def main():
     import os
     import jax
@@ -642,6 +709,9 @@ def main():
     # bound convention used by the scaling literature)
     flops_per_token = 6.0 * n_params
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+    # divergence-sentry recovery drill (ISSUE 12): enforced to actually
+    # roll back, priced separately from the throughput measurement
+    rollback = _train_rollback_drill()
     out = {
         "metric": "gpt2_345m_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -650,6 +720,7 @@ def main():
         "mfu": round(mfu, 4),
         "step_ms": round(dt * 1000, 2),
         "loss": float(loss),
+        **rollback,
     }
     print(json.dumps(out))
 

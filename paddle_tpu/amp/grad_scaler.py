@@ -11,6 +11,16 @@ step counters / param & accumulator snapshots), never python branches, so
 one compiled train step handles both the apply and the skip path — the
 exact role of the reference's update_loss_scaling op, which the executor
 also runs unconditionally.
+
+Sentry interplay (docs/RESILIENCE.md "Divergence sentry & rollback"): a
+``found_inf`` overflow skip is ROUTINE dynamic-loss-scale behavior, not
+a divergence — feed :attr:`found_inf` to
+``DivergenceSentry.observe(..., found_inf=...)`` so a backoff neither
+rolls training back nor perturbs the anomaly counters.  ``state_dict``
+/ ``load_state_dict`` ride the ``pack_state`` ``@scaler`` entry
+(ResilientLoop / hapi checkpoints and the memory snapshot ring), so a
+post-rollback or post-relaunch AMP run resumes with the live loss
+scale bitwise intact.
 """
 from __future__ import annotations
 
@@ -56,6 +66,21 @@ class GradScaler:
 
     def set_init_loss_scaling(self, v: float):
         self._scale_t._data = jnp.float32(v)
+
+    @property
+    def found_inf(self):
+        """The overflow latch from the last ``unscale_`` (a jax bool
+        scalar, possibly traced; None before the first unscale or after
+        ``update``).  Hand it to ``DivergenceSentry.observe`` so an AMP
+        skip is classified as routine, never as an anomaly."""
+        return self._found_inf
+
+    @property
+    def scale_tensor(self):
+        """The live loss-scale state tensor — read it inside a compiled
+        step (e.g. the sentry's per-step report lane) without a host
+        pull."""
+        return self._scale_t
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -164,9 +189,14 @@ class GradScaler:
         }
 
     def load_state_dict(self, sd):
-        self._scale_t._data = jnp.float32(jnp.asarray(sd["scale"]))
-        self._good_t._data = jnp.int32(jnp.asarray(sd.get("incr_count", 0)))
-        self._bad_t._data = jnp.int32(jnp.asarray(sd.get("decr_count", 0)))
+        # leaves may arrive as framework Tensors (disk generation load),
+        # jax/numpy arrays (memory snapshot ring), or python scalars —
+        # all legal resume sources
+        from ..core.tensor import _to_jax_array as _arr
+
+        self._scale_t._data = jnp.float32(_arr(sd["scale"]))
+        self._good_t._data = jnp.int32(_arr(sd.get("incr_count", 0)))
+        self._bad_t._data = jnp.int32(_arr(sd.get("decr_count", 0)))
         self._incr_ratio = float(sd.get("incr_ratio", self._incr_ratio))
         self._decr_ratio = float(sd.get("decr_ratio", self._decr_ratio))
         self._incr_every_n_steps = int(

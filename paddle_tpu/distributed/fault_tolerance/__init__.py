@@ -3,18 +3,33 @@
 Step-granular auto-resume (`ResilientLoop`), hang detection
 (`StepWatchdog`), and deterministic chaos injection (`FaultPlan`,
 `corrupt_shard`) over the hardened generation checkpoints of
-``distributed.checkpoint`` (CRC32 + verify + keep-last-K retention).
+``distributed.checkpoint`` (CRC32 + verify + keep-last-K retention) —
+plus the cheap recovery tier: in-graph divergence detection
+(`DivergenceSentry`), host-RAM snapshot rollback (`MemorySnapshotRing`),
+and automatic rollback-and-skip with `SentryEscalation` fail-stop after
+`max_rollbacks` consecutive failures.
 """
 from ..fleet.elastic.manager import ELASTIC_EXIT_CODE
 from .injection import (
     FaultPlan, ServingFaultPlan, ReplicaScopedFaultPlan, InjectedFault,
-    corrupt_shard, SERVING_FAULT_POINTS,
+    corrupt_shard, SERVING_FAULT_POINTS, TRAIN_FAULT_POINTS,
 )
+from .memory_checkpoint import MemorySnapshotRing, restore_packed_state
 from .resilient_loop import ResilientLoop, pack_state
+from .sentry import (
+    DivergenceSentry, SentryEscalation, SentryReport, global_grad_norm,
+    ANOMALY_NONFINITE_LOSS, ANOMALY_NONFINITE_GRAD, ANOMALY_LOSS_SPIKE,
+    ANOMALY_GRAD_RATIO,
+)
 from .watchdog import StepWatchdog, dump_all_stacks
 
 __all__ = [
     "ResilientLoop", "StepWatchdog", "FaultPlan", "ServingFaultPlan",
     "ReplicaScopedFaultPlan", "InjectedFault", "SERVING_FAULT_POINTS",
-    "corrupt_shard", "dump_all_stacks", "ELASTIC_EXIT_CODE", "pack_state",
+    "TRAIN_FAULT_POINTS", "corrupt_shard", "dump_all_stacks",
+    "ELASTIC_EXIT_CODE", "pack_state",
+    "DivergenceSentry", "SentryEscalation", "SentryReport",
+    "MemorySnapshotRing", "restore_packed_state", "global_grad_norm",
+    "ANOMALY_NONFINITE_LOSS", "ANOMALY_NONFINITE_GRAD",
+    "ANOMALY_LOSS_SPIKE", "ANOMALY_GRAD_RATIO",
 ]

@@ -23,6 +23,24 @@ the step that killed its predecessor (the predecessor's env is not
 inherited unless the harness re-sets it — but guard anyway: the chaos
 tests re-launch with the fault env cleared).
 
+Numerical train faults (the divergence-sentry chaos surface,
+docs/RESILIENCE.md "Divergence sentry & rollback") are *data-side*:
+``PADDLE_TPU_FT_TRAIN_FAULTS="train.nan@5,train.spike@7x2:factor=100"``
+arms step-keyed corruption rules, and the training script poisons its
+own batch through :meth:`FaultPlan.corrupt_batch` — the array keeps its
+shape and dtype, so a compiled train step sees the fault without a
+single new executable-cache key:
+
+- ``train.nan@N[xM]``     batches for steps [N, N+M) become all-NaN (a
+  transient hardware/data fault; the in-graph sentry must latch,
+  roll back, and skip the window);
+- ``train.spike@N[xM][:factor=F]``  batches scaled by ``F`` (default
+  1e4) — a finite loss spike, the divergence fail-stop never caught.
+
+Each rule fires at most once per step (a post-rollback replay of steps
+*before* the window re-corrupts nothing, and the blocklist keeps the
+window itself from re-running).
+
 Serving fault points (``ServingFaultPlan``) extend the same env-driven
 deterministic-trigger discipline to the serving engine: a fault is keyed
 to the Nth call of a named engine fault point (``serving.prefill``,
@@ -59,13 +77,24 @@ import time
 from typing import Optional
 
 __all__ = ["FaultPlan", "ServingFaultPlan", "ReplicaScopedFaultPlan",
-           "InjectedFault", "corrupt_shard", "SERVING_FAULT_POINTS"]
+           "InjectedFault", "corrupt_shard", "SERVING_FAULT_POINTS",
+           "TRAIN_FAULT_POINTS"]
 
 ENV_DIE_AT_STEP = "PADDLE_TPU_FT_DIE_AT_STEP"
 ENV_DIE_SIGNAL = "PADDLE_TPU_FT_DIE_SIGNAL"
 ENV_STALL_AT_STEP = "PADDLE_TPU_FT_STALL_AT_STEP"
 ENV_STALL_SECONDS = "PADDLE_TPU_FT_STALL_SECONDS"
 ENV_SERVING_FAULTS = "PADDLE_TPU_FT_SERVING_FAULTS"
+ENV_TRAIN_FAULTS = "PADDLE_TPU_FT_TRAIN_FAULTS"
+
+#: Step-keyed numerical fault points: data-side corruption applied via
+#: :meth:`FaultPlan.corrupt_batch` (shape/dtype-preserving, so compiled
+#: train steps see the fault with zero new executable-cache keys).
+TRAIN_FAULT_POINTS = ("train.nan", "train.spike")
+
+#: default multiplier for ``train.spike`` (finite, but far past any
+#: sane ``spike_factor`` threshold)
+DEFAULT_SPIKE_FACTOR = 1e4
 
 #: Fault points the serving engine checks (engine.py _step_call/_emit;
 #: ``serving.prefix_lookup`` fires inside the paged engine's host-side
@@ -95,17 +124,50 @@ def _parse_signal(spec: str) -> int:
     return int(getattr(signal, name))
 
 
+def _parse_train_faults(raw: str) -> list:
+    """``point@N[xM][:factor=F]`` comma-separated specs →
+    [{"kind", "at", "times", "factor"}]."""
+    rules = []
+    for spec in (s.strip() for s in raw.split(",")):
+        if not spec:
+            continue
+        point, sep, rest = spec.partition("@")
+        if not sep or point not in TRAIN_FAULT_POINTS:
+            raise ValueError(
+                f"bad train fault spec {spec!r}: expected "
+                f"point@N[xM][:factor=F] with point in {TRAIN_FAULT_POINTS}")
+        window, _, opt = rest.partition(":")
+        at, _, times = window.partition("x")
+        factor = DEFAULT_SPIKE_FACTOR
+        if opt:
+            key, _, val = opt.partition("=")
+            if key != "factor":
+                raise ValueError(f"bad train fault option {opt!r} in "
+                                 f"{spec!r}: only 'factor=<f>'")
+            factor = float(val)
+        if point == "train.nan" and opt:
+            raise ValueError(f"train.nan takes no options (got {spec!r})")
+        rules.append({"kind": point.split(".")[1], "at": int(at),
+                      "times": int(times) if times else 1,
+                      "factor": factor, "fired_steps": set()})
+        if rules[-1]["at"] < 0 or rules[-1]["times"] < 1:
+            raise ValueError(f"bad train fault window in {spec!r}")
+    return rules
+
+
 class FaultPlan:
     """The faults this process has been asked to inject, step-keyed."""
 
     def __init__(self, die_at_step: Optional[int] = None,
                  die_signal: int = signal.SIGTERM,
                  stall_at_step: Optional[int] = None,
-                 stall_seconds: float = 3600.0):
+                 stall_seconds: float = 3600.0,
+                 train_faults: Optional[list] = None):
         self.die_at_step = die_at_step
         self.die_signal = die_signal
         self.stall_at_step = stall_at_step
         self.stall_seconds = stall_seconds
+        self.train_faults = list(train_faults or [])
         self._fired_die = False
         self._fired_stall = False
 
@@ -117,11 +179,29 @@ class FaultPlan:
             die_at_step=int(die) if die is not None else None,
             die_signal=_parse_signal(env.get(ENV_DIE_SIGNAL, "TERM")),
             stall_at_step=int(stall) if stall is not None else None,
-            stall_seconds=float(env.get(ENV_STALL_SECONDS, "3600")))
+            stall_seconds=float(env.get(ENV_STALL_SECONDS, "3600")),
+            train_faults=_parse_train_faults(env.get(ENV_TRAIN_FAULTS, "")))
+
+    def add_train_fault(self, point: str, at_step: int, times: int = 1,
+                        factor: float = DEFAULT_SPIKE_FACTOR) -> "FaultPlan":
+        """In-process arming of a ``train.nan``/``train.spike`` rule (the
+        env path parses the same shape)."""
+        if point not in TRAIN_FAULT_POINTS:
+            raise ValueError(f"unknown train fault point {point!r}; want "
+                             f"one of {TRAIN_FAULT_POINTS}")
+        if at_step < 0 or times < 1:
+            raise ValueError("at_step must be >= 0 and times >= 1")
+        self.train_faults.append(
+            {"kind": point.split(".")[1], "at": int(at_step),
+             "times": int(times), "factor": float(factor),
+             "fired_steps": set()})
+        return self
 
     @property
     def armed(self) -> bool:
-        return self.die_at_step is not None or self.stall_at_step is not None
+        return (self.die_at_step is not None
+                or self.stall_at_step is not None
+                or bool(self.train_faults))
 
     def fire(self, step: int):
         """Called by ResilientLoop at the start of every step."""
@@ -131,6 +211,44 @@ class FaultPlan:
         if self.die_at_step == step and not self._fired_die:
             self._fired_die = True
             os.kill(os.getpid(), self.die_signal)
+
+    def corrupt_batch(self, step: int, batch):
+        """Apply any armed ``train.*`` rule for ``step`` to a batch —
+        numpy array or framework Tensor in, the same kind out, shape and
+        dtype preserved (a compiled step sees the fault without a new
+        cache key).  Each rule fires at most once per step, so replays
+        of pre-window steps are corruption-free.  Called by the training
+        script on its own data, mirroring how serving chaos rides the
+        production loop."""
+        rule = None
+        for r in self.train_faults:
+            if r["at"] <= step < r["at"] + r["times"] \
+                    and step not in r["fired_steps"]:
+                rule = r
+                break
+        if rule is None:
+            return batch
+        import numpy as np
+
+        is_tensor = hasattr(batch, "_value")  # framework Tensor
+        dtype = np.dtype(batch._value().dtype if is_tensor
+                         else np.asarray(batch).dtype)
+        if dtype.kind not in "fc":
+            # NaN/×factor cannot be represented in an integer batch
+            # (token ids): the cast would silently produce finite
+            # garbage and the sentry would never latch — corrupt float
+            # data (embeddings, targets, loss inputs) instead
+            raise ValueError(
+                f"train.{rule['kind']} fault needs a float batch, got "
+                f"dtype {dtype}; poison a float input of the step, not "
+                "integer token ids")
+        rule["fired_steps"].add(step)
+        factor = float("nan") if rule["kind"] == "nan" else rule["factor"]
+        if is_tensor:
+            return batch * factor
+        arr = np.asarray(batch)
+        return (arr * np.asarray(factor).astype(arr.dtype)).astype(
+            arr.dtype)
 
 
 class InjectedFault(RuntimeError):

@@ -1,0 +1,177 @@
+"""Memory-tier checkpoints: a bounded ring of host-RAM snapshots.
+
+The disk generations of ``distributed.checkpoint`` make a *process
+relaunch* cheap; this module makes an *in-process rollback* cheap — a
+divergence at step N restores the newest snapshot in RAM (milliseconds)
+instead of replaying from the last disk commit (up to ``save_every``
+steps of lost work, plus a relaunch).
+
+One schema, two tiers: every snapshot is the same
+:func:`~.resilient_loop.pack_state` payload the disk generations use
+(``{"user": ..., "@step": N, "@rng": ..., "@scaler": ...}``), so a
+memory snapshot can be committed straight to disk
+(``ResilientLoop`` does exactly that at sentry escalation) and a disk
+generation restores through the same code path as a ring snapshot —
+the tiers stay cross-restorable by construction
+(docs/RESILIENCE.md "Divergence sentry & rollback").
+
+Copy discipline: :meth:`MemorySnapshotRing.take` deep-copies every
+tensor leaf to host memory (``jax.device_get``) at capture time, and
+:meth:`newest` hands back a *fresh* restorable tree on every call — the
+ring can never alias a live parameter buffer (which a donating compiled
+train step would invalidate), and restoring twice is safe.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["MemorySnapshotRing", "restore_packed_state"]
+
+
+class _Leaf:
+    """A captured leaf: ``tag`` records what to rebuild on restore —
+    ``"T"`` framework Tensor, ``"A"`` raw (jax/numpy) array, ``"L"``
+    opaque python literal."""
+
+    __slots__ = ("tag", "value")
+
+    def __init__(self, tag: str, value):
+        self.tag = tag
+        self.value = value
+
+
+def _capture(obj):
+    """Nested state → host-owned copy tree, tagging each leaf so Tensor-
+    ness round-trips exactly."""
+    from ...core.tensor import Tensor
+
+    if isinstance(obj, dict):
+        return {k: _capture(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        items = [_capture(v) for v in obj]
+        return items if isinstance(obj, list) else tuple(items)
+    if isinstance(obj, Tensor):
+        import jax
+
+        return _Leaf("T", np.array(jax.device_get(obj._value()), copy=True))
+    if isinstance(obj, np.ndarray):
+        return _Leaf("A", np.array(obj, copy=True))
+    if type(obj).__module__.startswith(("jaxlib", "jax")):
+        import jax
+
+        return _Leaf("A", np.array(jax.device_get(obj), copy=True))
+    return _Leaf("L", obj)
+
+
+def _restore(node):
+    """Copy tree → fresh restorable state (new device buffers each call:
+    a donating train step consuming one restore can never corrupt the
+    ring or a second restore)."""
+    if isinstance(node, dict):
+        return {k: _restore(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        items = [_restore(v) for v in node]
+        return items if isinstance(node, list) else tuple(items)
+    if node.tag == "T":
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        return Tensor._wrap(jnp.asarray(np.array(node.value, copy=True)),
+                            stop_gradient=True)
+    if node.tag == "A":
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.array(node.value, copy=True))
+    return node.value
+
+
+def _tree_bytes(node) -> int:
+    if isinstance(node, dict):
+        return sum(_tree_bytes(v) for v in node.values())
+    if isinstance(node, (list, tuple)):
+        return sum(_tree_bytes(v) for v in node)
+    if node.tag in ("T", "A"):
+        return int(node.value.nbytes)
+    return 0
+
+
+class MemorySnapshotRing:
+    """Bounded FIFO of host-RAM state snapshots (newest last).
+
+    ``capacity`` bounds resident memory to
+    ``capacity x sizeof(packed state)``; taking a snapshot past it
+    evicts the oldest (counted in ``evictions``).  The newest snapshot
+    is the rollback target; older entries are insurance against an
+    anomaly that slipped past detection into the newest one.
+    """
+
+    def __init__(self, capacity: int = 2):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: List[Dict[str, Any]] = []
+        self.taken = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def take(self, state: Dict[str, Any]) -> int:
+        """Deep-copy ``state`` (a ``pack_state`` payload) to host RAM.
+        Returns the snapshot's step."""
+        step = int(state["@step"])
+        snap = _capture(state)
+        # re-taking a boundary (post-rollback replay recrosses its own
+        # snapshot cadence) REPLACES the entry instead of duplicating it
+        self._ring = [s for s in self._ring
+                      if int(s["@step"].value) != step]
+        self._ring.append(snap)
+        self.taken += 1
+        while len(self._ring) > self.capacity:
+            self._ring.pop(0)
+            self.evictions += 1
+        return step
+
+    def steps(self) -> List[int]:
+        """Snapshot steps currently retained, oldest first."""
+        return [int(s["@step"].value) for s in self._ring]
+
+    def newest(self) -> Optional[Dict[str, Any]]:
+        """A FRESH restorable copy of the newest snapshot (None when
+        empty).  The ring entry itself is never handed out."""
+        if not self._ring:
+            return None
+        return _restore(self._ring[-1])
+
+    def clear(self) -> None:
+        self._ring = []
+
+    def nbytes(self) -> int:
+        return sum(_tree_bytes(s) for s in self._ring)
+
+    def snapshot(self) -> dict:
+        """JSON-ready occupancy stats."""
+        return {"capacity": self.capacity, "depth": len(self._ring),
+                "steps": self.steps(), "taken": self.taken,
+                "evictions": self.evictions, "bytes": self.nbytes()}
+
+
+def restore_packed_state(state: Dict[str, Any], restore_fn,
+                         scaler=None, sentry=None,
+                         include_rng: bool = True) -> int:
+    """Restore one ``pack_state`` payload — ring snapshot or loaded disk
+    generation alike (the cross-tier restore path).  Returns the step
+    the state was packed at."""
+    restore_fn(state["user"])
+    if include_rng and state.get("@rng") is not None:
+        from ...core.rng import set_rng_state
+
+        set_rng_state(state["@rng"])
+    if scaler is not None and state.get("@scaler") is not None:
+        scaler.load_state_dict(state["@scaler"])
+    if sentry is not None and state.get("@sentry") is not None:
+        sentry.load_state_dict(state["@sentry"])
+    return int(state["@step"])

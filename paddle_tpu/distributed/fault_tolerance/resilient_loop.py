@@ -13,13 +13,28 @@ The contract (docs/RESILIENCE.md):
   manager relaunches it instead of counting it as a fault.
 - **Auto-resume.** On startup the loop loads the newest generation that
   passes ``verify_checkpoint`` (CRC + coverage), restores user state via
-  ``restore_fn``, restores RNG, and continues from the recorded step —
-  a resumed-after-kill run reaches a final state bitwise-identical to an
-  uninterrupted one (chaos-tested in tests/test_fault_tolerance.py).
+  ``restore_fn``, restores RNG (and the AMP ``scaler``, when one is
+  attached), and continues from the recorded step — a resumed-after-kill
+  run reaches a final state bitwise-identical to an uninterrupted one
+  (chaos-tested in tests/test_fault_tolerance.py).
 - **Hang detection.** With ``watchdog_timeout`` set, a step that crosses
-  no boundary within the deadline dumps all-thread stacks + the last
-  dispatched op and exits with the same relaunch code — a hung collective
-  becomes a restart, not a wedged pod.
+  no boundary within the deadline freezes the flight-recorder ring,
+  dumps all-thread stacks + the last dispatched op, and exits with the
+  same relaunch code — a hung collective becomes a restart, not a
+  wedged pod.
+- **Divergence rollback.** With a ``sentry``
+  (:class:`~.sentry.DivergenceSentry`), every step is checked by the
+  in-graph anomaly latch (one small host pull per step).  On anomaly
+  the loop restores the newest host-RAM snapshot
+  (:class:`~.memory_checkpoint.MemorySnapshotRing` — weights, optimizer,
+  RNG key state, GradScaler scale, sentry detector state), blocklists
+  the offending step's data window, and replays; after ``max_rollbacks``
+  consecutive failures it escalates to fail-stop
+  (:class:`~.sentry.SentryEscalation`) with a CRC-valid disk generation
+  committed and the frozen flight dump attached.  Recovery is
+  deterministic: a rolled-back run's final state is bitwise-identical
+  to an uninterrupted run executing the same effective step schedule
+  (tests/test_sentry.py).
 
 Usage::
 
@@ -29,11 +44,13 @@ Usage::
                           "opt": opt.state_dict()},
         restore_fn=lambda s: (model.set_state_dict(s["model"]),
                               opt.set_state_dict(s["opt"])),
-        save_every=100, keep_last=3, watchdog_timeout=300)
+        save_every=100, keep_last=3, watchdog_timeout=300,
+        sentry=DivergenceSentry(snapshot_every=25, ring_capacity=2))
     loop.run(train_one_step, num_steps=10_000)
 """
 from __future__ import annotations
 
+import json
 import signal
 import sys
 import threading
@@ -41,31 +58,40 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from .. import checkpoint as ckpt
+from ...obs.flight import FlightRecorder
 from ..fleet.elastic.manager import ELASTIC_EXIT_CODE
 from .injection import FaultPlan
+from .memory_checkpoint import restore_packed_state
+from .sentry import DivergenceSentry, SentryEscalation
 from .watchdog import StepWatchdog
 
 __all__ = ["ResilientLoop", "pack_state"]
 
 
 def pack_state(user_state: Dict[str, Any], step: int,
-               include_rng: bool = True) -> Dict[str, Any]:
+               include_rng: bool = True, scaler=None) -> Dict[str, Any]:
     """THE generation payload schema — every producer of resumable step
-    generations (ResilientLoop, hapi ModelCheckpoint) builds through
-    here so fit-produced and loop-produced checkpoints stay
-    cross-resumable."""
+    generations (ResilientLoop, hapi ModelCheckpoint, the memory
+    snapshot ring) builds through here so fit-produced, loop-produced,
+    memory-tier, and disk-tier checkpoints stay cross-resumable.
+
+    ``scaler`` (an ``amp.GradScaler``) adds an ``@scaler`` entry so an
+    AMP run resumes — or rolls back — with its live dynamic loss scale
+    instead of re-warming from ``init_loss_scaling``."""
     from ...core.rng import get_rng_state
 
     state: Dict[str, Any] = {"user": user_state, "@step": int(step)}
     if include_rng:
         state["@rng"] = get_rng_state()
+    if scaler is not None:
+        state["@scaler"] = scaler.state_dict()
     return state
 
 
 class ResilientLoop:
     """Wraps a user step function with checkpointing, preemption handling,
-    auto-resume, and hang detection.  See module docstring for the
-    contract."""
+    auto-resume, hang detection, and sentry-driven divergence rollback.
+    See module docstring for the contract."""
 
     def __init__(self, ckpt_dir: str,
                  state_fn: Callable[[], Dict[str, Any]],
@@ -76,7 +102,10 @@ class ResilientLoop:
                  include_rng: bool = True,
                  save_final: bool = True,
                  exit_code: int = ELASTIC_EXIT_CODE,
-                 verbose: bool = True):
+                 verbose: bool = True,
+                 sentry: Optional[DivergenceSentry] = None,
+                 scaler=None,
+                 flight_capacity: int = 256):
         if save_every is not None and save_every < 1:
             raise ValueError("save_every must be >= 1 (or None to disable)")
         if keep_last is not None and keep_last < 1:
@@ -93,6 +122,15 @@ class ResilientLoop:
         self.save_final = save_final
         self.exit_code = exit_code
         self.verbose = verbose
+        self.sentry = sentry
+        self.scaler = scaler
+        #: always-on training flight ring (obs.flight): per-step
+        #: summaries, frozen on sentry escalation and watchdog fire
+        self.flight = FlightRecorder(capacity=flight_capacity,
+                                     name="training")
+        #: wall seconds the most recent rollback restore took (the
+        #: bench's ``train_rollback_recovery_ms`` source)
+        self.last_rollback_recovery_s: Optional[float] = None
         self._preempt_sig: Optional[int] = None
         self._fault_plan = FaultPlan.from_env()
 
@@ -104,7 +142,8 @@ class ResilientLoop:
 
     def _save(self, completed: int):
         state = pack_state(self.state_fn(), completed,
-                           include_rng=self.include_rng)
+                           include_rng=self.include_rng,
+                           scaler=self.scaler)
         t0 = time.monotonic()
         ckpt.save_generation(state, self.ckpt_dir, completed,
                              keep_last=self.keep_last)
@@ -114,8 +153,6 @@ class ResilientLoop:
     def resume(self) -> int:
         """Restore the newest valid generation; returns the step index to
         continue from (0 on a fresh start)."""
-        from ...core.rng import set_rng_state
-
         found = ckpt.latest_valid(self.ckpt_dir)
         if found is None:
             self._log(f"no valid generation under {self.ckpt_dir}; "
@@ -126,12 +163,63 @@ class ResilientLoop:
         if self.include_rng:
             template["@rng"] = None
         state = ckpt.load_state_dict(path, template)
-        self.restore_fn(state["user"])
-        if self.include_rng and state.get("@rng") is not None:
-            set_rng_state(state["@rng"])
-        resumed = int(state["@step"])
+        resumed = restore_packed_state(
+            state, self.restore_fn, scaler=self.scaler,
+            include_rng=self.include_rng)
         self._log(f"resumed from generation {step} (step {resumed})")
         return resumed
+
+    # -- memory tier / sentry -------------------------------------------
+
+    def _mem_snapshot(self, completed: int):
+        state = pack_state(self.state_fn(), completed,
+                           include_rng=self.include_rng,
+                           scaler=self.scaler)
+        state["@sentry"] = self.sentry.state_dict()
+        self.sentry.ring.take(state)
+
+    def _restore_newest_snapshot(self) -> Optional[int]:
+        """Roll state back to the newest ring snapshot; returns its step
+        (None when the ring is empty)."""
+        snap = self.sentry.ring.newest()
+        if snap is None:
+            return None
+        t0 = time.monotonic()
+        step = restore_packed_state(
+            snap, self.restore_fn, scaler=self.scaler, sentry=self.sentry,
+            include_rng=self.include_rng)
+        self.last_rollback_recovery_s = time.monotonic() - t0
+        return step
+
+    def _escalate(self, step: int, report):
+        """The cheap tier gives up: leave a restorable world behind —
+        newest good snapshot restored and committed to disk (the
+        memory→disk cross-restore), flight ring frozen — then raise."""
+        good = self._restore_newest_snapshot()
+        if good is not None:
+            self._save(good)
+        dump = self.flight.dump("sentry_escalation")
+        self._log(f"sentry escalation at step {step}: "
+                  f"{report.flags() or [report.code]} after "
+                  f"{self.sentry.rollbacks} rollback(s); flight dump "
+                  f"frozen ({len(dump['events'])} steps)")
+        raise SentryEscalation(
+            f"divergence sentry escalated at step {step} "
+            f"(anomaly {report.flags() or report.code}; "
+            f"{self.sentry.max_rollbacks} consecutive rollbacks "
+            f"exhausted; last good disk generation: {good})",
+            step=step, report=report, flight_dump=dump)
+
+    def sentry_stats(self) -> dict:
+        """JSON-ready sentry/rollback counters (empty without a sentry)."""
+        if self.sentry is None:
+            return {}
+        out = dict(self.sentry.counters())
+        out["ring"] = self.sentry.ring.snapshot()
+        if self.last_rollback_recovery_s is not None:
+            out["last_rollback_recovery_ms"] = round(
+                self.last_rollback_recovery_s * 1e3, 3)
+        return out
 
     # -- preemption ------------------------------------------------------
 
@@ -159,6 +247,22 @@ class ResilientLoop:
     def preempted(self) -> bool:
         return self._preempt_sig is not None
 
+    def _on_watchdog_timeout(self):
+        """Freeze and surface the flight ring before the watchdog's
+        hard exit — the dump must outlive the process, so it goes to
+        stderr alongside the stack dump.  The stderr copy keeps only
+        the newest events (bounded, but still PARSEABLE json — a
+        string slice would cut mid-object); the full dump stays banked
+        on the recorder for in-process consumers."""
+        d = self.flight.dump("watchdog")
+        tail = dict(d, events=d["events"][-32:],
+                    events_elided=max(0, len(d["events"]) - 32))
+        try:
+            print(f"[flight] {json.dumps(tail)}", file=sys.stderr)
+        except (TypeError, ValueError):
+            print(f"[flight] dump of {len(d['events'])} steps "
+                  "(unserializable fields elided)", file=sys.stderr)
+
     # -- the loop --------------------------------------------------------
 
     def run(self, step_fn: Callable[[int], Any], num_steps: int) -> int:
@@ -167,10 +271,14 @@ class ResilientLoop:
         Returns the number of completed steps (== num_steps unless a
         SystemExit escaped).  Exits the process with ``exit_code`` when a
         preemption signal arrived (after committing a final generation).
-        """
+        With a sentry, anomalous steps roll back to the newest memory
+        snapshot and are skipped on replay; ``step_fn`` is never called
+        for a blocklisted step."""
         start = self.resume()
+        sentry = self.sentry
         watchdog = (StepWatchdog(self.watchdog_timeout,
-                                 exit_code=self.exit_code)
+                                 exit_code=self.exit_code,
+                                 on_timeout=self._on_watchdog_timeout)
                     if self.watchdog_timeout else None)
         saved_handlers = self._install_handlers()
         completed = start
@@ -189,21 +297,87 @@ class ResilientLoop:
         try:
             if watchdog is not None:
                 watchdog.start()
-            for step in range(start, num_steps):
-                if watchdog is not None:
-                    watchdog.notify(step)
-                self._fault_plan.fire(step)
-                step_fn(step)
+            if sentry is not None:
+                # seed the ring: a rollback target exists from step one
+                self._mem_snapshot(start)
+            step = start
+            while step < num_steps:
+                skipped = sentry is not None and sentry.should_skip(step)
+                if skipped:
+                    # blocklisted data window: step_fn is never called,
+                    # but the boundary still flows through the
+                    # preemption / snapshot / disk-commit checks below
+                    # (a cadence commit or SIGTERM landing exactly on a
+                    # skipped step must not be silently dropped)
+                    sentry.note_skip(step)
+                    self._log(f"skipping blocklisted step {step}")
+                else:
+                    if watchdog is not None:
+                        watchdog.notify(step)
+                    self._fault_plan.fire(step)
+                    step_fn(step)
+                    if sentry is not None:
+                        report = sentry.poll()
+                        if report.anomalous:
+                            action = sentry.note_anomaly(step, report)
+                            self.flight.record(step=step,
+                                               anomaly=report.code,
+                                               loss=report.loss,
+                                               grad_norm=report.grad_norm,
+                                               scale=report.scale)
+                            if watchdog is not None:
+                                # same rule as _commit: the snapshot
+                                # restore (full-state device_put) and
+                                # the escalation disk commit may
+                                # legally be slow — never leave the
+                                # step deadline armed over them, or
+                                # the watchdog os._exit()s mid-save;
+                                # the next iteration's notify re-arms
+                                watchdog.pause()
+                            if action == "escalate":
+                                self._escalate(step, report)
+                            target = self._restore_newest_snapshot()
+                            if target is None:
+                                # no snapshot yet (anomaly before the
+                                # seed could be taken is impossible, but
+                                # stay fail-safe): escalate rather than
+                                # continue on poisoned state
+                                self._escalate(step, report)
+                            sentry.rollbacks += 1
+                            recovery_ms = \
+                                self.last_rollback_recovery_s * 1e3
+                            self._log(
+                                f"anomaly {report.flags() or report.code}"
+                                f" at step {step}: rolled back to "
+                                f"snapshot {target} ({recovery_ms:.1f}ms)"
+                                f"; step {step} blocklisted")
+                            step = target
+                            continue
+                        sentry.note_clean(step)
                 completed = step + 1
+                if skipped:
+                    self.flight.record(step=step, skipped=1)
+                elif sentry is not None:
+                    self.flight.record(
+                        step=step, loss=report.loss,
+                        grad_norm=report.grad_norm, scale=report.scale,
+                        snapshot_age=(completed
+                                      - (sentry.ring.steps() or [start])[-1]))
+                else:
+                    self.flight.record(step=step)
                 if self.preempted:
                     _commit(completed)
                     self._log(f"preempted at step boundary {completed}; "
                               f"exiting {self.exit_code}")
                     raise SystemExit(self.exit_code)
+                if sentry is not None \
+                        and completed % sentry.snapshot_every == 0:
+                    self._mem_snapshot(completed)
                 if self.save_every is not None \
                         and completed % self.save_every == 0 \
                         and completed < num_steps:
                     _commit(completed, resume_step=step)
+                step += 1
             if self.save_final and num_steps > start:
                 _commit(num_steps)
             elif watchdog is not None:

@@ -1,0 +1,381 @@
+"""Divergence sentry — in-graph anomaly detection for training.
+
+The fail-stop stack (``FLAGS_check_nan_inf``, kill-and-relaunch from the
+last *disk* generation) treats a numerical fault as fatal: a NaN at step
+N throws away up to ``save_every`` steps of work, and a diverged-but-
+finite loss spike is not detected at all.  Production training treats
+divergence as a *recoverable* event: detect → roll back a few steps
+(from cheap in-memory snapshots) → skip the offending data window →
+continue.  :class:`DivergenceSentry` is the detection half of that
+contract; :class:`~.memory_checkpoint.MemorySnapshotRing` is the
+rollback tier and :class:`~.resilient_loop.ResilientLoop` /
+``hapi.Model.fit(sentry=...)`` own the policy loop
+(docs/RESILIENCE.md "Divergence sentry & rollback").
+
+House invariants, enforced by construction:
+
+- **The latch is computed in-graph.**  ``observe(loss, grad_norm=...)``
+  runs *inside* the (possibly compiled) train step: every check is a
+  ``jnp`` where-select over persistent state tensors
+  (``core.tensor.external_tensor`` — lifted into program inputs/outputs
+  exactly like optimizer accumulators and RNG state), never a python
+  branch on a traced value.  Attaching the sentry therefore adds ZERO
+  executable-cache keys: the compiled step's arg specs are untouched and
+  the sentry state rides the existing state-lifting machinery
+  (pinned in tests/test_sentry.py by the program-cache key-set check).
+- **One small host pull per step.**  Everything the host needs — the
+  anomaly code, the loss, the grad norm, the loss scale, the window
+  mean — is packed into ONE tiny f32 report lane on device;
+  :meth:`poll` pulls that single array and nothing else, so the tpulint
+  host-sync discipline holds (no per-field ``float()`` coercions).
+- **An AMP overflow skip is routine.**  ``observe(...,
+  found_inf=scaler.found_inf)`` forces the code to 0 and freezes the
+  window statistics for that step: a dynamic-loss-scale backoff is the
+  scaler's business and must neither roll back nor perturb the anomaly
+  counters (pinned in tests/test_sentry.py).
+
+Detection (bit flags, OR-ed into the report code):
+
+==========================  =================================================
+``ANOMALY_NONFINITE_LOSS``  loss is NaN/Inf
+``ANOMALY_NONFINITE_GRAD``  global grad norm is NaN/Inf
+``ANOMALY_LOSS_SPIKE``      loss > ``spike_factor`` x windowed mean (armed
+                            after ``min_history`` clean observations)
+``ANOMALY_GRAD_RATIO``      grad norm > ``grad_ratio`` x its EMA (same
+                            warmup)
+==========================  =================================================
+
+The sentry also owns the *policy* bookkeeping the rollback loops share:
+the step blocklist (offending data windows to skip), the consecutive-
+rollback counter feeding ``max_rollbacks`` escalation, and the snapshot
+ring itself.  Detector state (window, EMA, report) has a
+``state_dict``/``load_state_dict`` pair and is included in every
+snapshot, so a rolled-back run replays with the *pre-anomaly* detector —
+recovery is deterministic end to end.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, NamedTuple, Optional
+
+import numpy as np
+
+__all__ = [
+    "DivergenceSentry", "SentryReport", "SentryEscalation",
+    "global_grad_norm",
+    "ANOMALY_NONFINITE_LOSS", "ANOMALY_NONFINITE_GRAD",
+    "ANOMALY_LOSS_SPIKE", "ANOMALY_GRAD_RATIO",
+]
+
+ANOMALY_NONFINITE_LOSS = 1
+ANOMALY_NONFINITE_GRAD = 2
+ANOMALY_LOSS_SPIKE = 4
+ANOMALY_GRAD_RATIO = 8
+
+_FLAG_NAMES = (
+    (ANOMALY_NONFINITE_LOSS, "nonfinite_loss"),
+    (ANOMALY_NONFINITE_GRAD, "nonfinite_grad"),
+    (ANOMALY_LOSS_SPIKE, "loss_spike"),
+    (ANOMALY_GRAD_RATIO, "grad_ratio"),
+)
+
+#: report lane layout: [code, loss, grad_norm, scale, window_mean]
+_REPORT_LANES = 5
+
+
+class SentryReport(NamedTuple):
+    """One step's pulled sentry report (host-side, plain floats)."""
+
+    code: int
+    loss: float
+    grad_norm: float
+    scale: float
+    window_mean: float
+
+    @property
+    def anomalous(self) -> bool:
+        return self.code != 0
+
+    def flags(self) -> List[str]:
+        return [name for bit, name in _FLAG_NAMES if self.code & bit]
+
+
+class SentryEscalation(RuntimeError):
+    """Raised when ``max_rollbacks`` consecutive rollbacks could not get
+    past an anomaly: the cheap tier gives up and the run fail-stops with
+    the last disk checkpoint intact and the frozen flight-recorder dump
+    attached (``.flight_dump``)."""
+
+    def __init__(self, msg: str, step: int, report: SentryReport,
+                 flight_dump: Optional[dict] = None):
+        super().__init__(msg)
+        self.step = step
+        self.report = report
+        self.flight_dump = flight_dump
+
+
+def _as_f32_scalar(value):
+    """A traced-or-concrete value → f32 jax scalar (mean-reduced if the
+    caller handed a non-scalar — static shape check, trace-safe)."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import _to_jax_array
+
+    arr = _to_jax_array(value).astype(jnp.float32)
+    if arr.ndim:
+        arr = jnp.mean(arr)
+    return arr
+
+
+def global_grad_norm(parameters: Iterable):
+    """Global L2 norm over every present ``.grad`` — f32 accumulation,
+    trace-safe (the None checks are structural, never value-dependent).
+    Returns an f32 scalar ``Tensor``; feed it to
+    :meth:`DivergenceSentry.observe`."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+
+    total = jnp.float32(0.0)
+    for p in parameters:
+        g = p.grad
+        if g is None:
+            continue
+        ga = g._value() if isinstance(g, Tensor) else jnp.asarray(g)
+        ga = ga.astype(jnp.float32)
+        total = total + jnp.sum(ga * ga)
+    return Tensor._wrap(jnp.sqrt(total), stop_gradient=True)
+
+
+class DivergenceSentry:
+    """In-graph anomaly latch + rollback policy state (module docstring).
+
+    Args:
+        window: loss-history ring length for the spike check.
+        spike_factor: loss > ``spike_factor * window_mean`` flags a spike.
+        grad_ratio: grad norm > ``grad_ratio * ema`` flags a blow-up.
+        min_history: clean observations before spike/ratio checks arm
+            (non-finite checks are always armed).
+        ema_decay: grad-norm EMA decay.
+        snapshot_every: memory-snapshot cadence (completed steps) the
+            driving loop follows.
+        ring_capacity: snapshot ring depth (newest
+            ``ring_capacity`` snapshots are rollback candidates).
+        max_rollbacks: consecutive rollbacks tolerated before
+            :class:`SentryEscalation` (0 = escalate on first anomaly).
+        blocklist: steps to skip from the start — how the bitwise-parity
+            oracle replays a chaos run's *effective* schedule.
+    """
+
+    def __init__(self, window: int = 32, spike_factor: float = 4.0,
+                 grad_ratio: float = 10.0, min_history: int = 8,
+                 ema_decay: float = 0.9, snapshot_every: int = 10,
+                 ring_capacity: int = 2, max_rollbacks: int = 3,
+                 blocklist: Iterable[int] = ()):
+        from .memory_checkpoint import MemorySnapshotRing
+
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if min_history < 1:
+            raise ValueError(f"min_history must be >= 1, got {min_history}")
+        if spike_factor <= 1.0 or grad_ratio <= 1.0:
+            raise ValueError("spike_factor and grad_ratio must be > 1 "
+                             f"(got {spike_factor}, {grad_ratio})")
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        if snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {snapshot_every}")
+        if max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {max_rollbacks}")
+        self.window = int(window)
+        self.spike_factor = float(spike_factor)
+        self.grad_ratio = float(grad_ratio)
+        self.min_history = int(min_history)
+        self.ema_decay = float(ema_decay)
+        self.snapshot_every = int(snapshot_every)
+        self.max_rollbacks = int(max_rollbacks)
+        self.blocklist = set(int(s) for s in blocklist)
+        self.ring = MemorySnapshotRing(ring_capacity)
+        # host-side policy counters
+        self.anomalies = 0
+        self.rollbacks = 0
+        self.escalations = 0
+        self.skipped_steps = 0
+        self.polls = 0
+        self._consecutive = 0
+        self._last_anomaly_step = -1
+        self._build_state()
+
+    def _build_state(self):
+        from ...core import tensor as tensor_mod
+
+        # persistent DEVICE state: lifted into compiled train steps like
+        # optimizer accumulators — zero host round-trips to maintain.
+        # Loss and grad observations are counted SEPARATELY: under grad
+        # accumulation the sentry sees a loss every micro-batch but a
+        # grad norm only on update batches, and arming the ratio check
+        # on loss warmth alone would fire off a one-sample EMA.
+        self._hist = tensor_mod.external_tensor(
+            np.zeros(self.window, np.float32))
+        self._n = tensor_mod.external_tensor(np.int32(0))
+        self._gn = tensor_mod.external_tensor(np.int32(0))
+        self._gema = tensor_mod.external_tensor(np.float32(0.0))
+        self._report = tensor_mod.external_tensor(
+            np.zeros(_REPORT_LANES, np.float32))
+
+    # -- in-graph latch ------------------------------------------------------
+
+    def observe(self, loss, grad_norm=None, found_inf=None, scale=None):
+        """Record one train step INSIDE the (possibly compiled) step.
+
+        Pure where-select math over the lifted state tensors — safe under
+        ``jit.to_static`` and identical eagerly.  ``found_inf`` (the AMP
+        scaler's latch) marks the step as a routine overflow skip: code
+        forced to 0, window statistics frozen.  Anomalous steps likewise
+        never enter the window — the history stays clean for the post-
+        rollback replay.  May be called several times between polls
+        (micro-batches under grad accumulation): the report LATCHES the
+        first anomalous observe until :meth:`poll` clears it."""
+        import jax.numpy as jnp
+
+        la = _as_f32_scalar(loss)
+        has_g = grad_norm is not None
+        g = _as_f32_scalar(grad_norm) if has_g else jnp.float32(0.0)
+        sc = _as_f32_scalar(scale) if scale is not None else jnp.float32(1.0)
+
+        hist = self._hist._value()
+        n = self._n._value()
+        gn = self._gn._value()
+        gema = self._gema._value()
+
+        filled = jnp.minimum(n, self.window)
+        mean = jnp.sum(hist) / jnp.maximum(filled, 1).astype(jnp.float32)
+        warm = n >= self.min_history
+
+        loss_ok = jnp.isfinite(la)
+        code = jnp.where(loss_ok, 0, ANOMALY_NONFINITE_LOSS)
+        # the spike check arms only on a strictly positive window mean:
+        # a negative-loss objective (log-likelihood/ELBO) or a loss
+        # converged to ~0 has no meaningful multiplicative baseline, and
+        # a floor there would flag EVERY positive step as a spike (the
+        # non-finite checks still guard such runs)
+        spike = warm & loss_ok & (mean > 0.0) \
+            & (la > self.spike_factor * mean)
+        code = code + jnp.where(spike, ANOMALY_LOSS_SPIKE, 0)
+        if has_g:
+            grad_ok = jnp.isfinite(g)
+            code = code + jnp.where(grad_ok, 0, ANOMALY_NONFINITE_GRAD)
+            # armed on GRAD warmth, not loss warmth: grads may be
+            # observed less often (accumulation windows)
+            ratio = (gn >= self.min_history) & grad_ok & (gema > 0.0) \
+                & (g > self.grad_ratio * gema)
+            code = code + jnp.where(ratio, ANOMALY_GRAD_RATIO, 0)
+
+        if found_inf is not None:
+            # AMP overflow skip: the scaler already rolled the step back
+            # and will back its scale off — routine, NOT an anomaly
+            from ...core.tensor import _to_jax_array
+
+            routine = _to_jax_array(found_inf).astype(jnp.bool_)
+            code = jnp.where(routine, 0, code)
+        else:
+            routine = jnp.bool_(False)
+
+        ok = (code == 0) & ~routine
+        idx = jnp.mod(n, self.window)
+        new_hist = hist.at[idx].set(jnp.where(ok, la, hist[idx]))
+        new_n = n + jnp.where(ok, 1, 0).astype(n.dtype)
+        if has_g:
+            seeded = jnp.where(gn > 0, self.ema_decay * gema
+                               + (1.0 - self.ema_decay) * g, g)
+            self._gema._set_data(jnp.where(ok, seeded, gema))
+            self._gn._set_data(gn + jnp.where(ok, 1, 0).astype(gn.dtype))
+        self._hist._set_data(new_hist)
+        self._n._set_data(new_n)
+        # the report LATCHES: multiple observes may land between polls
+        # (one per micro-batch under grad accumulation, one poll per
+        # step) and an anomaly in any of them must survive to the poll
+        # — first anomalous observe wins the whole lane (its loss/grad
+        # values are the diagnosis); poll() clears the latch
+        prev = self._report._value()
+        fresh = jnp.stack([code.astype(jnp.float32), la, g, sc, mean])
+        self._report._set_data(
+            jnp.where(prev[0].astype(jnp.int32) > 0, prev, fresh))
+
+    # -- host surface --------------------------------------------------------
+
+    def poll(self) -> SentryReport:
+        """Pull THE step's report — the sentry's single small host
+        transfer (one [5] f32 array) — and clear the latch, so the next
+        window of observes starts clean."""
+        import jax
+        import jax.numpy as jnp
+
+        vec = np.asarray(jax.device_get(self._report._data))
+        self.polls += 1
+        self._report._set_data(jnp.zeros(_REPORT_LANES, jnp.float32))
+        return SentryReport(code=int(vec[0]), loss=float(vec[1]),
+                            grad_norm=float(vec[2]), scale=float(vec[3]),
+                            window_mean=float(vec[4]))
+
+    def should_skip(self, step: int) -> bool:
+        return int(step) in self.blocklist
+
+    def note_skip(self, step: int) -> None:
+        self.skipped_steps += 1
+
+    def note_anomaly(self, step: int, report: SentryReport) -> str:
+        """Policy transition for one detected anomaly: blocklist the
+        offending step, bump the consecutive counter, and answer
+        ``"rollback"`` or ``"escalate"``."""
+        self.anomalies += 1
+        self.blocklist.add(int(step))
+        self._consecutive += 1
+        self._last_anomaly_step = max(self._last_anomaly_step, int(step))
+        if self._consecutive > self.max_rollbacks:
+            self.escalations += 1
+            return "escalate"
+        return "rollback"
+
+    def note_clean(self, step: int) -> None:
+        """A clean completed step PAST the last anomaly is real progress:
+        the consecutive-rollback counter resets (a clean replay of
+        pre-anomaly steps is not progress and must not reset it)."""
+        if self._consecutive and int(step) > self._last_anomaly_step:
+            self._consecutive = 0
+
+    def counters(self) -> dict:
+        """JSON-ready policy counters (bench + flight-recorder surface)."""
+        return {
+            "anomalies": self.anomalies,
+            "rollbacks": self.rollbacks,
+            "escalations": self.escalations,
+            "skipped_steps": self.skipped_steps,
+            "consecutive": self._consecutive,
+            "blocklist": sorted(self.blocklist),
+            "snapshots": self.ring.taken,
+            "snapshot_steps": self.ring.steps(),
+        }
+
+    # -- detector-state persistence (rides every snapshot) -------------------
+
+    def state_dict(self) -> dict:
+        """DEVICE detector state only — the window, EMA, counter, and
+        report lanes.  Policy state (blocklist, consecutive counter)
+        deliberately stays host-side: a rollback must KEEP the entry it
+        just blocklisted."""
+        return {"hist": self._hist, "n": self._n, "gn": self._gn,
+                "gema": self._gema, "report": self._report}
+
+    def load_state_dict(self, sd: dict) -> None:
+        import jax.numpy as jnp
+
+        from ...core.tensor import _to_jax_array as _arr
+
+        self._hist._set_data(_arr(sd["hist"]).astype(jnp.float32))
+        self._n._set_data(_arr(sd["n"]).astype(jnp.int32))
+        self._gn._set_data(_arr(sd.get("gn", 0)).astype(jnp.int32))
+        self._gema._set_data(_arr(sd["gema"]).astype(jnp.float32))
+        if "report" in sd:
+            self._report._set_data(_arr(sd["report"]).astype(jnp.float32))

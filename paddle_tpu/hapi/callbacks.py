@@ -60,6 +60,17 @@ class Callback:
     def on_predict_batch_end(self, step, logs=None):
         pass
 
+    def on_rollback(self, step, report=None):
+        """Divergence-sentry rollback: training state was just restored
+        from a memory snapshot and global step ``step`` was blocklisted
+        (``fit(sentry=...)``, docs/RESILIENCE.md).  ``report`` is the
+        triggering ``SentryReport``.  This REPLACES
+        ``on_train_batch_end`` for the rolled-back batch: its effects
+        were undone, so per-batch-end hooks (LR stepping, counters)
+        must not run for it — an ``on_train_batch_begin`` paired with
+        ``on_rollback`` is the anomalous-batch signature."""
+        pass
+
 
 class CallbackList:
     def __init__(self, callbacks):
@@ -116,6 +127,12 @@ class ProgBarLogger(Callback):
             items = " - ".join(
                 f"{k}: {v}" for k, v in logs.items() if k != "batch_size")
             print(f"Eval - {items}")
+
+    def on_rollback(self, step, report=None):
+        if self.verbose:
+            what = ",".join(report.flags()) if report is not None else "?"
+            print(f"step {step + 1}: divergence ({what}) - rolled back "
+                  "to last snapshot, window skipped")
 
 
 class ModelCheckpoint(Callback):

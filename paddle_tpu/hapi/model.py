@@ -27,6 +27,11 @@ class Model:
         self._metrics = []
         self.stop_training = False
         self._amp_level = None
+        self._scaler = None
+        #: the report train_batch pulled for its sentry (one poll per
+        #: batch); fit's rollback policy reads it instead of polling a
+        #: second time
+        self._last_sentry_report = None
 
     # -- configuration -----------------------------------------------------
 
@@ -44,6 +49,11 @@ class Model:
             self._amp_level = amp_configs
         elif isinstance(amp_configs, dict):
             self._amp_level = amp_configs.get("level", "O1")
+            # reference amp_configs carries loss-scaling knobs; here a
+            # prepared GradScaler rides along so fit's AMP path uses
+            # dynamic loss scaling AND its state joins every checkpoint
+            # tier (docs/RESILIENCE.md "Divergence sentry & rollback")
+            self._scaler = amp_configs.get("scaler", self._scaler)
         return self
 
     # -- single-batch paths --------------------------------------------------
@@ -55,15 +65,34 @@ class Model:
             return self._loss(*outs, *lbls)
         raise ValueError("Model.prepare(loss=...) required for training")
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, sentry=None):
         self.network.train()
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         ins = [x if isinstance(x, Tensor) else to_tensor(x) for x in ins]
+        scaler = self._scaler if (self._scaler is not None
+                                  and self._scaler.is_enable()) else None
+
+        def _observe(loss, grads_ready, found_inf=None):
+            # in-graph sentry latch: runs between backward and the
+            # optimizer step so the grad norm is the raw global norm,
+            # and an AMP found_inf skip is classified as routine
+            if sentry is None:
+                return
+            grad_norm = None
+            if grads_ready and self._optimizer is not None:
+                from ..distributed.fault_tolerance import global_grad_norm
+
+                grad_norm = global_grad_norm(
+                    self._optimizer._parameter_list or [])
+            sentry.observe(loss, grad_norm=grad_norm, found_inf=found_inf,
+                           scale=None if scaler is None
+                           else scaler.scale_tensor)
 
         def _run():
             outputs = self.network(*ins)
             loss = self._compute_loss(outputs, labels)
             loss.backward()
+            _observe(loss, grads_ready=update)
             if update:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
@@ -75,12 +104,36 @@ class Model:
             with amp_mod.auto_cast(level=self._amp_level):
                 outputs = self.network(*ins)
             loss = self._compute_loss(outputs, labels)
-            loss.backward()
-            if update:
-                self._optimizer.step()
-                self._optimizer.clear_grad()
+            if scaler is not None:
+                scaler.scale(loss).backward()
+                if update:
+                    scaler.unscale_(self._optimizer)
+                    _observe(loss, grads_ready=True,
+                             found_inf=scaler.found_inf)
+                    scaler.step(self._optimizer)
+                    scaler.update()
+                    self._optimizer.clear_grad()
+                else:
+                    _observe(loss, grads_ready=False)
+            else:
+                loss.backward()
+                _observe(loss, grads_ready=update)
+                if update:
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
         else:
             outputs, loss = _run()
+        self._last_sentry_report = None
+        if sentry is not None:
+            # poll HERE (still the one pull per batch — fit reads
+            # _last_sentry_report instead of polling again) so an
+            # anomalous batch never reaches the metric accumulators:
+            # a rolled-back batch must leave no trace in them either
+            self._last_sentry_report = sentry.poll()
+            if self._last_sentry_report.anomalous:
+                # the polled report already holds the loss host-side —
+                # no second device pull on the rollback path
+                return [self._last_sentry_report.loss]
         metrics = [float(np.asarray(loss.numpy()))]
         for m in self._metrics:
             pre = m.compute(outputs if not isinstance(outputs, (list, tuple))
@@ -155,7 +208,20 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, save_steps=None,
-            keep_last=3, resume=False):
+            keep_last=3, resume=False, sentry=None):
+        """Train the prepared model (reference `hapi/model.py:1574`).
+
+        ``sentry`` (a ``distributed.fault_tolerance.DivergenceSentry``)
+        arms divergence rollback: each batch is checked by the in-graph
+        anomaly latch (one small host pull); on anomaly fit restores the
+        newest memory snapshot (weights, optimizer, RNG, GradScaler) and
+        continues with the NEXT batch — the offending window is skipped,
+        not replayed (fit's loaders are not step-replayable; drive
+        training with ``ResilientLoop`` for bitwise replay semantics).
+        After ``max_rollbacks`` consecutive failures a
+        ``SentryEscalation`` fail-stops the fit with the flight-recorder
+        dump attached and any ``save_dir`` checkpoints intact.
+        """
         train_loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
 
@@ -180,6 +246,13 @@ class Model:
             "epochs": epochs, "steps": steps, "verbose": verbose,
             "batch_size": batch_size, "metrics": self._metrics_name(),
         })
+        flight = None
+        gstep = int(self._resumed_step or 0)
+        if sentry is not None:
+            from ..obs.flight import FlightRecorder
+
+            flight = FlightRecorder(name="training")
+            self._sentry_snapshot(sentry, gstep)   # seed a rollback target
         self.stop_training = False
         cbk_list.on_train_begin()
         for epoch in range(epochs):
@@ -191,14 +264,48 @@ class Model:
             logs = {}
             step_count = 0
             for step, batch in enumerate(train_loader):
+                if sentry is not None and sentry.should_skip(gstep):
+                    # skip only bypasses the batch itself: the boundary
+                    # still flows through the flight ring and the
+                    # snapshot cadence (a cadence landing exactly on a
+                    # skipped step must not shrink the rollback window)
+                    sentry.note_skip(gstep)
+                    flight.record(step=gstep, skipped=1)
+                    gstep += 1
+                    if gstep % sentry.snapshot_every == 0:
+                        self._sentry_snapshot(sentry, gstep)
+                    step_count += 1
+                    if num_iters is not None and step_count >= num_iters:
+                        break
+                    continue
                 cbk_list.on_train_batch_begin(step)
                 x, y = self._unpack(batch)
                 update = ((step + 1) % accumulate_grad_batches == 0)
-                outs = self.train_batch(x, y, update=update)
+                outs = self.train_batch(x, y, update=update, sentry=sentry)
+                if sentry is not None:
+                    report = self._last_sentry_report
+                    flight.record(step=gstep, anomaly=report.code,
+                                  loss=report.loss,
+                                  grad_norm=report.grad_norm,
+                                  scale=report.scale)
+                    if report.anomalous:
+                        self._sentry_rollback(sentry, gstep, report,
+                                              cbk_list, flight)
+                        gstep += 1
+                        step_count += 1
+                        if num_iters is not None \
+                                and step_count >= num_iters:
+                            break
+                        continue
+                    sentry.note_clean(gstep)
                 logs = {"loss": outs[0]}
                 for m in self._metrics:
                     logs[_name_str(m.name())] = _fmt_metric(m.accumulate())
                 cbk_list.on_train_batch_end(step, logs)
+                gstep += 1
+                if sentry is not None \
+                        and gstep % sentry.snapshot_every == 0:
+                    self._sentry_snapshot(sentry, gstep)
                 step_count += 1
                 if num_iters is not None and step_count >= num_iters:
                     break
@@ -266,17 +373,20 @@ class Model:
             self._optimizer.set_state_dict(user_state["opt"])
 
     def _ft_state_dict(self, step):
-        """Generation payload via the shared ResilientLoop schema, so
-        fit-produced step checkpoints and ResilientLoop ones share one
-        resume contract (docs/RESILIENCE.md)."""
+        """Generation payload via the shared ResilientLoop schema
+        (including the AMP GradScaler when one is prepared), so
+        fit-produced step checkpoints, ResilientLoop ones, and memory-
+        ring snapshots share one resume contract (docs/RESILIENCE.md)."""
         from ..distributed.fault_tolerance import pack_state
 
-        return pack_state(self._ft_user_state(), step)
+        return pack_state(self._ft_user_state(), step,
+                          scaler=self._scaler)
 
     def resume_from(self, ckpt_root):
-        """Restore params/optimizer/RNG from the newest VALID step
-        generation under ``ckpt_root`` (corrupt/torn generations are
-        skipped).  Returns the restored global step (0 = fresh start).
+        """Restore params/optimizer/RNG (and GradScaler state, when one
+        is prepared) from the newest VALID step generation under
+        ``ckpt_root`` (corrupt/torn generations are skipped).  Returns
+        the restored global step (0 = fresh start).
 
         Note: fit-level resume restores state and continues generation
         numbering; it does not fast-forward the data iterator to the
@@ -286,8 +396,55 @@ class Model:
         from ..distributed.fault_tolerance import ResilientLoop
 
         loop = ResilientLoop(ckpt_root, state_fn=self._ft_user_state,
-                             restore_fn=self._ft_restore, verbose=False)
+                             restore_fn=self._ft_restore, verbose=False,
+                             scaler=self._scaler)
         return loop.resume()
+
+    # -- divergence sentry (fit-level policy) ----------------------------------
+
+    def _sentry_snapshot(self, sentry, gstep):
+        state = self._ft_state_dict(gstep)
+        state["@sentry"] = sentry.state_dict()
+        sentry.ring.take(state)
+
+    def _sentry_rollback(self, sentry, gstep, report, cbk_list, flight):
+        """Fit-level anomaly policy: restore the newest ring snapshot
+        and move on to the next batch (the offending window is skipped,
+        never replayed); escalate after ``max_rollbacks`` consecutive
+        failures with the flight ring frozen onto the exception."""
+        from ..distributed.fault_tolerance import (
+            SentryEscalation, restore_packed_state)
+
+        action = sentry.note_anomaly(gstep, report)
+        if action == "escalate":
+            # leave the live model restored to the newest good snapshot
+            # (not the poisoned weights) before fail-stopping, same as
+            # ResilientLoop._escalate
+            snap = sentry.ring.newest()
+            if snap is not None:
+                restore_packed_state(snap, self._ft_restore,
+                                     scaler=self._scaler, sentry=sentry)
+            dump = flight.dump("sentry_escalation")
+            raise SentryEscalation(
+                f"divergence sentry escalated at fit step {gstep} "
+                f"(anomaly {report.flags() or report.code}; "
+                f"{sentry.max_rollbacks} consecutive rollbacks exhausted)",
+                step=gstep, report=report, flight_dump=dump)
+        snap = sentry.ring.newest()
+        restore_packed_state(snap, self._ft_restore, scaler=self._scaler,
+                             sentry=sentry)
+        if self._optimizer is not None:
+            # grads accumulated from the poisoned batch (including a
+            # non-update micro-batch under accumulate_grad_batches)
+            # are NOT part of the snapshot — clear them, or the NaN
+            # keeps contaminating every later accumulation window
+            self._optimizer.clear_grad()
+        sentry.rollbacks += 1
+        # on_rollback IS the terminal event for this batch: the matching
+        # on_train_batch_end deliberately does not fire (the batch's
+        # effects were rolled back — per-batch-end hooks like LR
+        # stepping must not run for it)
+        cbk_list.on_rollback(gstep, report)
 
     # -- persistence -----------------------------------------------------------
 

@@ -20,12 +20,28 @@ A thin, dependency-free export layer over
 
 Everything here is host-side and read-only: exporting never touches an
 engine, a traced value, or a compiled program.
+
+:class:`~.flight.FlightRecorder` also lives here — the always-on
+bounded step-summary ring both the serving engine and the training
+runtime feed (frozen into a post-mortem dump on unhealthy/eject/
+sentry-escalation/watchdog events).
 """
+from .flight import FlightRecorder  # noqa: F401
 from .perfetto import chrome_trace, write_chrome_trace  # noqa: F401
 from .jsonl import jsonl_lines, write_jsonl  # noqa: F401
 from .metrics import render_metrics, render_all_metrics  # noqa: F401
-from ..serving.tracing import validate_trace  # noqa: F401
 
-__all__ = ["chrome_trace", "write_chrome_trace", "jsonl_lines",
-           "write_jsonl", "render_metrics", "render_all_metrics",
-           "validate_trace"]
+__all__ = ["FlightRecorder", "chrome_trace", "write_chrome_trace",
+           "jsonl_lines", "write_jsonl", "render_metrics",
+           "render_all_metrics", "validate_trace"]
+
+
+def __getattr__(name):
+    # lazy: serving.tracing imports obs.flight at module top, so an
+    # eager import here would be circular (obs partially initialized
+    # when tracing asks back for it)
+    if name == "validate_trace":
+        from ..serving.tracing import validate_trace
+
+        return validate_trace
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

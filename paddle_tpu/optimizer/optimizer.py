@@ -360,7 +360,20 @@ class Optimizer:
             pkey, aname = k.rsplit("/", 1)
             pkey = remap.get(pkey, pkey)
             arr = v._value() if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
-            self._accumulators.setdefault(pkey, {})[aname] = Tensor._wrap(arr)
+            accs = self._accumulators.setdefault(pkey, {})
+            existing = accs.get(aname)
+            if existing is not None \
+                    and tuple(existing.shape) == tuple(arr.shape) \
+                    and existing._value().dtype == arr.dtype:
+                # restore IN PLACE: a compiled train step lifted the
+                # existing accumulator tensor as persistent program
+                # state, so a mid-run restore (divergence-sentry
+                # rollback) must write through the same object —
+                # replacing it would leave the program updating a
+                # tensor the optimizer no longer reads
+                existing._set_data(arr)
+            else:
+                accs[aname] = Tensor._wrap(arr)
 
 
 class SGD(Optimizer):

@@ -105,15 +105,18 @@ def _register_flight_recorder(r) -> None:
     _flight_recorders.append(_weakref.ref(r))
 
 
-def serving_flight_record() -> dict:
-    """Flight-recorder surface (ISSUE 9): for every engine that has one,
-    the bounded ring of recent step summaries plus any post-mortem
-    dumps frozen when ``health()`` flipped unhealthy or the fleet
-    ejected the replica.  Keyed by engine name; an ejected-and-rebuilt
-    replica's generations share its name, and the fleet's banked
-    ejection dumps (``FleetMetrics.flight_cb``) are merged in so a dump
-    survives its engine being discarded.  Returns
-    ``{engine_name: [snapshot_or_dump, ...]}`` (newest last)."""
+def flight_record() -> dict:
+    """Flight-recorder surface (ISSUE 9, generalized in ISSUE 12): for
+    every live recorder — serving engines AND training loops (the
+    ``"training"`` ring ``ResilientLoop`` feeds) — the bounded ring of
+    recent step summaries plus any post-mortem dumps frozen when
+    ``health()`` flipped unhealthy, the fleet ejected the replica, the
+    divergence sentry escalated, or the step watchdog fired.  Keyed by
+    recorder name; an ejected-and-rebuilt replica's generations share
+    its name, and the fleet's banked ejection dumps
+    (``FleetMetrics.flight_cb``) are merged in so a dump survives its
+    engine being discarded.  Returns
+    ``{name: [snapshot_or_dump, ...]}`` (newest last)."""
     out: dict = {}
     seen_dumps = set()
     live = []
@@ -137,6 +140,11 @@ def serving_flight_record() -> dict:
                     out.setdefault(name, []).append(
                         {"name": name, "banked": True, "dumps": [d]})
     return out
+
+
+#: serving-era alias for :func:`flight_record` (pre-ISSUE-12 name; the
+#: registry has always been recorder-agnostic)
+serving_flight_record = flight_record
 
 
 def serving_fleet() -> dict:

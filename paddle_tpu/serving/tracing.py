@@ -43,8 +43,11 @@ House invariants, enforced by construction:
 buffer of the last N engine-step summaries, dumped automatically when
 ``health()`` flips unhealthy or the fleet ejects the replica — the
 post-mortem the aggregate counters cannot provide, surfaced via
-``profiler.serving_flight_record()`` and attached to the fleet's
-rebuild record.
+``profiler.flight_record()`` and attached to the fleet's rebuild
+record.  The recorder itself now lives in the shared observability
+layer (:mod:`paddle_tpu.obs.flight` — the training runtime's
+divergence sentry feeds one too) and is re-exported here so serving
+imports keep working.
 
 Exporters live in :mod:`paddle_tpu.obs` (Chrome/Perfetto trace JSON,
 JSONL event log, metrics text exposition); :func:`validate_trace` is
@@ -56,8 +59,9 @@ import itertools
 import os
 import time
 import weakref
-from collections import deque
 from typing import Dict, List, Optional
+
+from ..obs.flight import FlightRecorder  # noqa: F401  (re-export)
 
 __all__ = ["RequestTracer", "NullTracer", "NULL_TRACER", "FlightRecorder",
            "validate_trace", "TERMINAL_SPAN_STATES"]
@@ -459,61 +463,5 @@ def validate_trace(tracer: RequestTracer) -> List[str]:
 
 
 # -- flight recorder ---------------------------------------------------------
-
-class FlightRecorder:
-    """Always-on bounded ring of the last N engine-step summaries.
-
-    One per engine, fed by ``Engine.step()`` with a handful of host
-    ints (cost: one small dict append per step).  When the engine flips
-    unhealthy — or the fleet ejects the replica — the ring is frozen
-    into a **dump**: the last N steps leading up to the failure, the
-    post-mortem aggregate counters cannot reconstruct.  Dumps are kept
-    (newest last, at most ``max_dumps``) and surfaced through
-    ``profiler.serving_flight_record()``; the fleet additionally banks
-    the ejection dump on the replica's rebuild record.
-    """
-
-    def __init__(self, capacity: int = 256, name: str = "engine", *,
-                 max_dumps: int = 4):
-        if capacity < 1:
-            raise ValueError(f"capacity must be >= 1, got {capacity}")
-        self.name = name
-        self.capacity = int(capacity)
-        self.max_dumps = int(max_dumps)
-        self._ring: deque = deque(maxlen=self.capacity)
-        self.steps_seen = 0
-        self.dumps: List[dict] = []
-        from .. import profiler as _profiler
-
-        _profiler._register_flight_recorder(self)
-
-    def record(self, **fields) -> None:
-        """Append one step summary (host ints only — the caller is the
-        scheduler loop, so this must stay allocation-light)."""
-        self.steps_seen += 1
-        fields["t"] = round(time.perf_counter(), 6)
-        self._ring.append(fields)
-
-    def dump(self, reason: str) -> dict:
-        """Freeze the ring into a post-mortem record (newest events
-        last).  Safe to call from the watchdog thread: the scheduler is
-        stalled when the watchdog fires, so the ring is quiescent; a
-        racing append at worst drops this dump's tail."""
-        try:
-            events = [dict(e) for e in self._ring]
-        except RuntimeError:             # ring mutated mid-copy
-            events = []
-        d = {"name": self.name, "reason": reason,
-             "wall_time": time.time(), "steps_seen": self.steps_seen,
-             "events": events}
-        self.dumps.append(d)
-        del self.dumps[:-self.max_dumps]
-        return d
-
-    def snapshot(self) -> dict:
-        """JSON-ready view: ring occupancy plus every retained dump."""
-        return {"name": self.name, "capacity": self.capacity,
-                "steps_seen": self.steps_seen,
-                "ring_depth": len(self._ring),
-                "dumps": [dict(d, events=[dict(e) for e in d["events"]])
-                          for d in self.dumps]}
+# FlightRecorder moved to paddle_tpu.obs.flight (the shared observability
+# layer — training's divergence sentry feeds one too); re-exported above.

@@ -24,6 +24,15 @@ def test_smoke_mode_emits_json_line():
     assert out["metric"] == "gpt2_345m_train_tokens_per_sec_per_chip"
     assert out["value"] > 0
     assert "vs_baseline" in out
+    # divergence-sentry rollback drill (ISSUE 12): the injected NaN was
+    # detected in-graph, rolled back from the memory snapshot ring
+    # (measured restore time), and the window skipped — bench.py exits
+    # nonzero unless the recovery actually ran; these assertions pin
+    # the fields onto the one-JSON-line contract
+    assert out["train_rollback_recovery_ms"] > 0
+    assert out["train_sentry_anomalies"] >= 1
+    assert out["train_sentry_rollbacks"] >= 1
+    assert out["train_sentry_skipped_steps"] >= 1
 
 
 @pytest.mark.slow

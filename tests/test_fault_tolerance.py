@@ -119,6 +119,11 @@ class TestChaosWatchdog:
         assert "last dispatched op" in r.stderr
         # the stack dump names the sleeping injection frame on some thread
         assert "--- thread" in r.stderr
+        # the training flight ring is frozen and surfaced before the
+        # hard exit (ISSUE 12): the post-mortem names the wedged run's
+        # last steps, not just its stacks
+        assert '[flight] {"name": "training", "reason": "watchdog"' \
+            in r.stderr
 
     def test_watchdog_unit_notify_keeps_it_quiet(self):
         fired = []

@@ -1,0 +1,731 @@
+"""Divergence sentry & rollback (ISSUE 12, docs/RESILIENCE.md
+"Divergence sentry & rollback").
+
+The acceptance bar:
+
+- an injected transient NaN under ``jit.to_static`` latches in-graph,
+  rolls training back to the newest memory snapshot, skips the
+  offending window, and the final weights/optimizer/RNG are **bitwise
+  identical** to an uninterrupted run executing the same effective step
+  schedule — with ZERO new executable-cache keys across the rollback;
+- a finite loss spike and a grad-norm blow-up are detected too;
+- an AMP ``found_inf`` overflow skip is routine: no rollback, no
+  anomaly counters, scale backs off normally;
+- ``max_rollbacks`` consecutive failures escalate to fail-stop with a
+  CRC-valid disk generation on disk and a frozen flight-recorder dump
+  attached;
+- the snapshot ring evicts oldest-first and never aliases live buffers;
+- GradScaler state rides every checkpoint tier bitwise.
+"""
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.fault_tolerance import (
+    ANOMALY_GRAD_RATIO, ANOMALY_LOSS_SPIKE, ANOMALY_NONFINITE_GRAD,
+    ANOMALY_NONFINITE_LOSS, DivergenceSentry, FaultPlan,
+    MemorySnapshotRing, ResilientLoop, SentryEscalation, global_grad_norm,
+    pack_state, restore_packed_state)
+
+import jax
+import jax.numpy as jnp
+
+
+def _i(t):
+    return int(np.asarray(jax.device_get(t._value())))
+
+
+def _digest(net, opt):
+    """sha256 over params + optimizer tensors + RNG — the bitwise
+    identity oracle (same shape as tests/assets/ft_train.py)."""
+    h = hashlib.sha256()
+    for _, v in net.state_dict().items():
+        h.update(np.ascontiguousarray(np.asarray(v.numpy())).tobytes())
+    for _, v in opt.state_dict().items():
+        if hasattr(v, "numpy"):
+            h.update(np.ascontiguousarray(np.asarray(v.numpy())).tobytes())
+    h.update(np.asarray(paddle.get_rng_state().numpy()).tobytes())
+    return h.hexdigest()
+
+
+class TestDetectorUnit:
+    def _sentry(self, **kw):
+        kw.setdefault("window", 8)
+        kw.setdefault("min_history", 3)
+        kw.setdefault("spike_factor", 4.0)
+        kw.setdefault("grad_ratio", 10.0)
+        return DivergenceSentry(**kw)
+
+    def test_nonfinite_loss_latches(self):
+        s = self._sentry()
+        s.observe(paddle.to_tensor(np.float32("nan")))
+        r = s.poll()
+        assert r.code & ANOMALY_NONFINITE_LOSS
+        assert r.anomalous and "nonfinite_loss" in r.flags()
+        assert np.isnan(r.loss)
+
+    def test_loss_spike_needs_warmup(self):
+        s = self._sentry()
+        # below min_history the spike check is unarmed — a 100x early
+        # swing is noise, not divergence
+        s.observe(paddle.to_tensor(np.float32(1.0)))
+        s.observe(paddle.to_tensor(np.float32(100.0)))
+        assert not s.poll().anomalous
+        s2 = self._sentry()
+        for _ in range(4):
+            s2.observe(paddle.to_tensor(np.float32(1.0)))
+            assert not s2.poll().anomalous
+        s2.observe(paddle.to_tensor(np.float32(50.0)))
+        r = s2.poll()
+        assert r.code == ANOMALY_LOSS_SPIKE
+        assert r.window_mean == pytest.approx(1.0)
+        # the anomalous loss never entered the window: history stays
+        # clean for the post-rollback replay
+        assert _i(s2.state_dict()["n"]) == 4
+
+    def test_spike_disarmed_on_nonpositive_mean(self):
+        """A negative-loss objective (log-likelihood/ELBO) or a loss
+        converged to ~0 has no multiplicative spike baseline: the spike
+        check must disarm, not flag every positive step."""
+        s = self._sentry()
+        for _ in range(5):
+            s.observe(paddle.to_tensor(np.float32(-3.0)))
+        s.observe(paddle.to_tensor(np.float32(0.5)))
+        assert not s.poll().anomalous
+        s2 = self._sentry()
+        for _ in range(5):
+            s2.observe(paddle.to_tensor(np.float32(0.0)))
+        s2.observe(paddle.to_tensor(np.float32(1e-6)))
+        assert not s2.poll().anomalous
+        # non-finite detection still guards such runs
+        s2.observe(paddle.to_tensor(np.float32("inf")))
+        assert s2.poll().code & ANOMALY_NONFINITE_LOSS
+
+    def test_grad_norm_checks(self):
+        s = self._sentry()
+        for _ in range(4):
+            s.observe(paddle.to_tensor(np.float32(1.0)),
+                      grad_norm=paddle.to_tensor(np.float32(2.0)))
+        s.observe(paddle.to_tensor(np.float32(1.0)),
+                  grad_norm=paddle.to_tensor(np.float32(2000.0)))
+        assert s.poll().code == ANOMALY_GRAD_RATIO
+        s.observe(paddle.to_tensor(np.float32(1.0)),
+                  grad_norm=paddle.to_tensor(np.float32("inf")))
+        assert s.poll().code == ANOMALY_NONFINITE_GRAD
+
+    def test_found_inf_is_routine(self):
+        """An AMP overflow skip must neither flag nor perturb the
+        window statistics (ISSUE 12 satellite)."""
+        s = self._sentry()
+        for _ in range(4):
+            s.observe(paddle.to_tensor(np.float32(1.0)),
+                      grad_norm=paddle.to_tensor(np.float32(1.0)))
+        n_before = _i(s.state_dict()["n"])
+        # overflow step: nonfinite grads AND a wild loss, but found_inf
+        # says the scaler already rolled it back — routine
+        s.observe(paddle.to_tensor(np.float32(500.0)),
+                  grad_norm=paddle.to_tensor(np.float32("inf")),
+                  found_inf=jnp.bool_(True))
+        r = s.poll()
+        assert not r.anomalous and r.code == 0
+        assert _i(s.state_dict()["n"]) == n_before
+        # the very next clean step is still clean
+        s.observe(paddle.to_tensor(np.float32(1.0)),
+                  grad_norm=paddle.to_tensor(np.float32(1.0)))
+        assert not s.poll().anomalous
+
+    def test_anomaly_latches_across_observes_until_poll(self):
+        """Micro-batches under grad accumulation: several observes may
+        land between polls, and an anomaly in ANY of them must survive
+        a later clean observe — first anomalous observe wins the lane,
+        poll clears the latch."""
+        s = self._sentry()
+        for _ in range(4):
+            s.observe(paddle.to_tensor(np.float32(1.0)))
+            s.poll()
+        s.observe(paddle.to_tensor(np.float32("nan")))
+        s.observe(paddle.to_tensor(np.float32(1.0)))   # clean follow-up
+        r = s.poll()
+        assert r.code & ANOMALY_NONFINITE_LOSS
+        assert np.isnan(r.loss)        # the anomalous lane, not the clean one
+        assert s.poll().code == 0      # cleared
+
+    def test_report_scale_lane(self):
+        s = self._sentry()
+        s.observe(paddle.to_tensor(np.float32(1.0)),
+                  scale=paddle.to_tensor(np.float32(4096.0)))
+        assert s.poll().scale == 4096.0
+
+    def test_policy_counters(self):
+        s = self._sentry(max_rollbacks=1)
+        r = s.poll()
+        assert s.note_anomaly(5, r) == "rollback"
+        assert s.should_skip(5) and not s.should_skip(4)
+        s.note_clean(4)        # replayed pre-anomaly step: NOT progress
+        assert s.note_anomaly(6, r) == "escalate"
+        s2 = self._sentry(max_rollbacks=1)
+        s2.note_anomaly(5, r)
+        s2.note_clean(6)       # progress past the anomaly resets
+        assert s2.note_anomaly(7, r) == "rollback"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DivergenceSentry(window=0)
+        with pytest.raises(ValueError):
+            DivergenceSentry(spike_factor=1.0)
+        with pytest.raises(ValueError):
+            DivergenceSentry(snapshot_every=0)
+        with pytest.raises(ValueError):
+            DivergenceSentry(max_rollbacks=-1)
+        with pytest.raises(ValueError):
+            MemorySnapshotRing(0)
+
+
+class TestSnapshotRing:
+    def test_retention_and_eviction(self):
+        ring = MemorySnapshotRing(capacity=3)
+        for step in range(1, 6):
+            ring.take({"user": {"w": paddle.to_tensor(
+                np.full((2, 2), step, np.float32))}, "@step": step})
+        assert ring.steps() == [3, 4, 5]
+        assert len(ring) == 3 and ring.taken == 5 and ring.evictions == 2
+        snap = ring.snapshot()
+        assert snap["depth"] == 3 and snap["bytes"] > 0
+
+    def test_retake_same_step_replaces(self):
+        ring = MemorySnapshotRing(capacity=2)
+        for step in (2, 4, 4):     # post-rollback replay recrosses 4
+            ring.take({"user": {}, "@step": step})
+        assert ring.steps() == [2, 4]
+        assert ring.evictions == 0
+
+    def test_newest_is_fresh_copy(self):
+        ring = MemorySnapshotRing(capacity=2)
+        w = paddle.to_tensor(np.ones((2, 2), np.float32))
+        ring.take({"user": {"w": w}, "@step": 1})
+        a = ring.newest()
+        a["user"]["w"]._set_data(jnp.zeros((2, 2), jnp.float32))
+        b = ring.newest()
+        np.testing.assert_array_equal(
+            np.asarray(b["user"]["w"].numpy()), np.ones((2, 2)))
+        assert b["user"]["w"] is not w
+
+    def test_memory_and_disk_tiers_cross_restore(self, tmp_path):
+        """A ring snapshot commits straight to disk as a CRC-valid
+        generation, and the loaded generation restores through the same
+        path as a ring snapshot — one schema, two tiers."""
+        paddle.seed(11)
+        scaler = GradScaler(init_loss_scaling=1536.0)
+        w = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        state = pack_state({"w": w}, 7, scaler=scaler)
+        ring = MemorySnapshotRing(capacity=1)
+        ring.take(state)
+
+        root = str(tmp_path / "ck")
+        ckpt.save_generation(ring.newest(), root, 7)
+        assert ckpt.verify_checkpoint(ckpt.generation_dir(root, 7)) == []
+
+        got = {}
+        scaler2 = GradScaler(init_loss_scaling=2.0)
+        step, loaded = ckpt.load_generation(root)
+        restored_step = restore_packed_state(
+            loaded, lambda u: got.update(u), scaler=scaler2)
+        assert step == restored_step == 7
+        np.testing.assert_array_equal(np.asarray(got["w"].numpy()),
+                                      np.asarray(w.numpy()))
+        assert scaler2.get_loss_scaling() == 1536.0
+
+
+class TestTrainFaultInjection:
+    def test_env_parsing(self):
+        plan = FaultPlan.from_env({
+            "PADDLE_TPU_FT_TRAIN_FAULTS":
+                "train.nan@5, train.spike@7x2:factor=100"})
+        assert plan.armed
+        assert [r["kind"] for r in plan.train_faults] == ["nan", "spike"]
+        assert plan.train_faults[1]["times"] == 2
+        assert plan.train_faults[1]["factor"] == 100.0
+        assert not FaultPlan.from_env({}).armed
+
+    def test_bad_specs_raise(self):
+        for bad in ("train.nope@3", "train.nan", "train.nan@3:factor=2",
+                    "train.spike@3:stall=1"):
+            with pytest.raises(ValueError):
+                FaultPlan.from_env({"PADDLE_TPU_FT_TRAIN_FAULTS": bad})
+
+    def test_corrupt_batch_window_and_once_per_step(self):
+        plan = FaultPlan().add_train_fault("train.nan", 5) \
+                          .add_train_fault("train.spike", 8, times=2,
+                                           factor=50.0)
+        x = np.ones(4, np.float32)
+        assert np.isfinite(plan.corrupt_batch(4, x)).all()
+        out = plan.corrupt_batch(5, x)
+        assert np.isnan(out).all()
+        assert out.shape == x.shape and out.dtype == x.dtype
+        # fires at most once per step: a post-rollback replay of step 5
+        # (were it not blocklisted) sees clean data
+        assert np.isfinite(plan.corrupt_batch(5, x)).all()
+        np.testing.assert_array_equal(plan.corrupt_batch(8, x), x * 50)
+        np.testing.assert_array_equal(plan.corrupt_batch(9, x), x * 50)
+        assert np.isfinite(plan.corrupt_batch(10, x)).all()
+        # framework Tensor in → Tensor out
+        t = plan.corrupt_batch(5, paddle.to_tensor(x))
+        np.testing.assert_array_equal(np.asarray(t.numpy()), x)
+
+    def test_corrupt_batch_rejects_integer_batches(self):
+        """NaN cast to int silently yields finite garbage the sentry
+        would never latch on — the fault point refuses token-id
+        batches instead of arming a no-op chaos drill."""
+        plan = FaultPlan().add_train_fault("train.nan", 2)
+        ids = np.arange(6, dtype=np.int32)
+        np.testing.assert_array_equal(plan.corrupt_batch(1, ids), ids)
+        with pytest.raises(ValueError, match="float batch"):
+            plan.corrupt_batch(2, ids)
+        with pytest.raises(ValueError, match="float batch"):
+            plan.corrupt_batch(2, paddle.to_tensor(ids))
+
+
+def _to_static_rig(blocklist=()):
+    """Tiny compiled train step (fwd+bwd+AdamW+dropout RNG) with the
+    sentry latch INSIDE the program — the effective-schedule oracle
+    reuses it with a pre-seeded blocklist."""
+    paddle.seed(42)
+    net = nn.Linear(6, 6)
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    sentry = DivergenceSentry(window=8, min_history=2, spike_factor=4.0,
+                              grad_ratio=100.0, snapshot_every=2,
+                              ring_capacity=2, max_rollbacks=2,
+                              blocklist=blocklist)
+
+    @paddle.jit.to_static
+    def train_step(x):
+        y = F.dropout(net(x), p=0.25, training=True)
+        loss = (y * y).mean()
+        loss.backward()
+        sentry.observe(loss, grad_norm=global_grad_norm(net.parameters()))
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return net, opt, sentry, train_step
+
+
+def _batch_for(step):
+    rs = np.random.RandomState(1000 + step)
+    return rs.randn(4, 6).astype(np.float32)
+
+
+class TestRollbackParity:
+    def test_injected_nan_rollback_is_bitwise_identical(self, tmp_path):
+        """THE tentpole bar: transient NaN at step 5 under jit.to_static
+        → in-graph latch → rollback to the ring snapshot → skip → final
+        state bitwise-identical to an uninterrupted run on the same
+        effective schedule, at zero new executable-cache keys."""
+        plan = FaultPlan().add_train_fault("train.nan", 5)
+        net1, opt1, s1, step1 = _to_static_rig()
+
+        def chaos_fn(step):
+            x = plan.corrupt_batch(step, _batch_for(step))
+            step1(paddle.to_tensor(x))
+
+        loop1 = ResilientLoop(
+            str(tmp_path / "chaos"),
+            state_fn=lambda: {"model": net1.state_dict(),
+                              "opt": opt1.state_dict()},
+            restore_fn=lambda s: (net1.set_state_dict(s["model"]),
+                                  opt1.set_state_dict(s["opt"])),
+            save_every=4, keep_last=2, sentry=s1, verbose=False)
+        # warm the one program, then pin its key set: the rollback path
+        # must add ZERO executable-cache keys (miss counters flat)
+        chaos_fn(0)
+        keys_warm = set(step1.program_cache.keys())
+        assert len(keys_warm) == 1
+        completed = loop1.run(chaos_fn, 10)
+
+        assert completed == 10
+        assert set(step1.program_cache.keys()) == keys_warm
+        assert s1.anomalies == 1 and s1.rollbacks == 1
+        assert sorted(s1.blocklist) == [5]
+        assert s1.skipped_steps == 1
+        assert loop1.last_rollback_recovery_s > 0
+        stats = loop1.sentry_stats()
+        assert stats["last_rollback_recovery_ms"] > 0
+        assert stats["ring"]["depth"] == 2
+        # the flight ring saw the anomaly step
+        assert any(e.get("anomaly") for e in loop1.flight._ring)
+
+        # oracle: the same EFFECTIVE schedule (5 pre-blocklisted), no
+        # fault, fresh identical rig
+        net2, opt2, s2, step2 = _to_static_rig(blocklist={5})
+
+        def oracle_fn(step):
+            step2(paddle.to_tensor(_batch_for(step)))
+
+        loop2 = ResilientLoop(
+            str(tmp_path / "oracle"),
+            state_fn=lambda: {"model": net2.state_dict(),
+                              "opt": opt2.state_dict()},
+            restore_fn=lambda s: (net2.set_state_dict(s["model"]),
+                                  opt2.set_state_dict(s["opt"])),
+            save_every=4, keep_last=2, sentry=s2, verbose=False)
+        oracle_fn(0)
+        loop2.run(oracle_fn, 10)
+        assert s2.anomalies == 0
+        assert _digest(net1, opt1) == _digest(net2, opt2)
+        assert len(step2.program_cache) == 1
+
+    def test_skipped_step_still_hits_commit_boundary(self, tmp_path):
+        """A save_every boundary landing exactly on a blocklisted step
+        must still commit: the skip path only bypasses step_fn, never
+        the checkpoint/preemption checks."""
+        net, opt, sentry, train_step = _to_static_rig(blocklist={3})
+
+        def step_fn(step):
+            train_step(paddle.to_tensor(_batch_for(step)))
+
+        root = str(tmp_path / "ck")
+        loop = ResilientLoop(
+            root,
+            state_fn=lambda: {"model": net.state_dict(),
+                              "opt": opt.state_dict()},
+            restore_fn=lambda s: (net.set_state_dict(s["model"]),
+                                  opt.set_state_dict(s["opt"])),
+            save_every=4, keep_last=3, save_final=False, sentry=sentry,
+            verbose=False)
+        loop.run(step_fn, 6)
+        # completed crosses 4 AT skipped step 3 — the generation exists
+        assert 4 in ckpt.list_generations(root)
+
+    def test_finite_spike_rolls_back_too(self, tmp_path):
+        """The divergence class fail-stop never caught: a finite loss
+        spike (train.spike fault) latches and rolls back."""
+        plan = FaultPlan().add_train_fault("train.spike", 6, factor=1e4)
+        net, opt, sentry, train_step = _to_static_rig()
+
+        def step_fn(step):
+            x = plan.corrupt_batch(step, _batch_for(step))
+            train_step(paddle.to_tensor(x))
+
+        loop = ResilientLoop(
+            str(tmp_path / "spike"),
+            state_fn=lambda: {"model": net.state_dict(),
+                              "opt": opt.state_dict()},
+            restore_fn=lambda s: (net.set_state_dict(s["model"]),
+                                  opt.set_state_dict(s["opt"])),
+            save_every=None, save_final=False, sentry=sentry,
+            verbose=False)
+        loop.run(step_fn, 9)
+        assert sentry.anomalies == 1 and sentry.rollbacks == 1
+        assert sorted(sentry.blocklist) == [6]
+        final = np.asarray(net.state_dict()["weight"].numpy())
+        assert np.isfinite(final).all()
+
+
+class TestEscalation:
+    def test_max_rollbacks_escalates_fail_safe(self, tmp_path):
+        """Persistent corruption defeats the cheap tier: after
+        max_rollbacks consecutive rollbacks the loop fail-stops with a
+        CRC-valid disk generation committed from the newest good
+        snapshot and the frozen flight dump attached."""
+        paddle.seed(9)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        sentry = DivergenceSentry(window=4, min_history=2,
+                                  snapshot_every=2, ring_capacity=2,
+                                  max_rollbacks=2)
+
+        def step_fn(step):
+            x = _batch_for(step)[:, :4]
+            if step >= 3:                    # persistent data corruption
+                x = x * np.nan
+            y = net(paddle.to_tensor(x))
+            loss = (y * y).mean()
+            loss.backward()
+            sentry.observe(loss,
+                           grad_norm=global_grad_norm(net.parameters()))
+            opt.step()
+            opt.clear_grad()
+
+        root = str(tmp_path / "ck")
+        loop = ResilientLoop(
+            root,
+            state_fn=lambda: {"model": net.state_dict(),
+                              "opt": opt.state_dict()},
+            restore_fn=lambda s: (net.set_state_dict(s["model"]),
+                                  opt.set_state_dict(s["opt"])),
+            save_every=2, keep_last=2, sentry=sentry, verbose=False)
+        with pytest.raises(SentryEscalation) as ei:
+            loop.run(step_fn, 10)
+
+        exc = ei.value
+        assert sentry.rollbacks == 2 and sentry.escalations == 1
+        assert exc.report.anomalous
+        # the flight dump is frozen and attached, and banked on the
+        # recorder for the profiler surface
+        assert exc.flight_dump["reason"] == "sentry_escalation"
+        assert exc.flight_dump["events"]
+        assert loop.flight.dumps[-1] is exc.flight_dump
+        from paddle_tpu import profiler
+
+        recs = profiler.flight_record().get("training", [])
+        assert any(d["reason"] == "sentry_escalation"
+                   for r in recs for d in r.get("dumps", []))
+        # fail-safe: a CRC-verified generation survives at the restored
+        # snapshot step (4: the boundary reached by replaying step 2 and
+        # skipping blocklisted 3 — skip boundaries hit the snapshot and
+        # commit cadences too), and the restored state is finite
+        step, path = ckpt.latest_valid(root)
+        assert ckpt.verify_checkpoint(path) == []
+        assert step == 4
+        w = np.asarray(net.state_dict()["weight"].numpy())
+        assert np.isfinite(w).all()
+
+
+class TestScalerContinuity:
+    def _scaled_rig(self, seed=5):
+        paddle.seed(seed)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        scaler = GradScaler(init_loss_scaling=512.0, incr_ratio=2.0,
+                            incr_every_n_steps=2)
+        return net, opt, scaler
+
+    def _scaled_step(self, net, opt, scaler):
+        def step_fn(step):
+            x = paddle.to_tensor(_batch_for(step)[:, :4])
+            loss = (net(x) ** 2).mean()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+        return step_fn
+
+    def test_disk_resume_restores_scale_bitwise(self, tmp_path):
+        """ISSUE 12 satellite: pack_state(scaler=...) carries the live
+        dynamic loss scale through the disk tier — a relaunched AMP run
+        resumes with the grown scale, not init_loss_scaling."""
+        net1, opt1, scaler1 = self._scaled_rig()
+        root = str(tmp_path / "ck")
+        loop1 = ResilientLoop(
+            root, state_fn=lambda: {"model": net1.state_dict(),
+                                    "opt": opt1.state_dict()},
+            restore_fn=lambda s: net1.set_state_dict(s["model"]),
+            save_every=2, scaler=scaler1, verbose=False)
+        loop1.run(self._scaled_step(net1, opt1, scaler1), 5)
+        grown = scaler1.get_loss_scaling()
+        assert grown == 2048.0            # 512 x 2 x 2 (incr every 2)
+
+        net2, opt2, scaler2 = self._scaled_rig(seed=6)
+        assert scaler2.get_loss_scaling() == 512.0
+        loop2 = ResilientLoop(
+            root, state_fn=lambda: {"model": net2.state_dict(),
+                                    "opt": opt2.state_dict()},
+            restore_fn=lambda s: net2.set_state_dict(s["model"]),
+            scaler=scaler2, verbose=False)
+        assert loop2.resume() == 5
+        assert scaler2.get_loss_scaling() == grown
+        sd1, sd2 = scaler1.state_dict(), scaler2.state_dict()
+        np.testing.assert_array_equal(np.asarray(sd1["scale"]),
+                                      np.asarray(sd2["scale"]))
+        np.testing.assert_array_equal(np.asarray(sd1["incr_count"]),
+                                      np.asarray(sd2["incr_count"]))
+
+    def test_ring_rollback_restores_scale(self):
+        scaler = GradScaler(init_loss_scaling=1024.0)
+        ring = MemorySnapshotRing(capacity=1)
+        ring.take(pack_state({}, 4, scaler=scaler))
+        scaler._scale_t._data = jnp.float32(64.0)   # post-snapshot drift
+        restore_packed_state(ring.newest(), lambda u: None, scaler=scaler)
+        assert scaler.get_loss_scaling() == 1024.0
+
+    def test_amp_overflow_backoff_does_not_roll_back(self, tmp_path):
+        """E2E interplay pin: a dynamic-loss-scale overflow skip under
+        the sentry backs the scale off WITHOUT tripping the anomaly
+        counters — even though the grads that step are Inf."""
+        paddle.seed(13)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        scaler = GradScaler(init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1, decr_ratio=0.5,
+                            incr_every_n_steps=1000)
+        sentry = DivergenceSentry(window=8, min_history=1,
+                                  spike_factor=4.0, grad_ratio=10.0,
+                                  snapshot_every=2, ring_capacity=2,
+                                  max_rollbacks=1)
+
+        def step_fn(step):
+            x = paddle.to_tensor(_batch_for(step)[:, :4])
+            loss = (net(x) ** 2).mean()
+            scaler.scale(loss).backward()
+            if step == 3:   # simulated f16 overflow: every grad → Inf
+                for p in net.parameters():
+                    p.grad = p.grad * np.float32("inf")
+            scaler.unscale_(opt)
+            sentry.observe(loss,
+                           grad_norm=global_grad_norm(net.parameters()),
+                           found_inf=scaler.found_inf,
+                           scale=scaler.scale_tensor)
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+
+        loop = ResilientLoop(
+            str(tmp_path / "ck"),
+            state_fn=lambda: {"model": net.state_dict(),
+                              "opt": opt.state_dict()},
+            restore_fn=lambda s: net.set_state_dict(s["model"]),
+            save_every=None, save_final=False, sentry=sentry,
+            scaler=scaler, verbose=False)
+        loop.run(step_fn, 6)
+        assert sentry.anomalies == 0 and sentry.rollbacks == 0
+        assert sentry.blocklist == set()
+        assert scaler.get_loss_scaling() == 512.0   # exactly one backoff
+        w = np.asarray(net.state_dict()["weight"].numpy())
+        assert np.isfinite(w).all()
+
+
+class TestHapiFit:
+    def _model(self, scaler=None):
+        paddle.seed(21)
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=net.parameters())
+        amp = {"level": "O1", "scaler": scaler} if scaler else None
+        model.prepare(optimizer=opt,
+                      loss=lambda out, y: ((out - y) ** 2).mean(),
+                      amp_configs=amp)
+        return model
+
+    def _data(self, n=10, poison=()):
+        rs = np.random.RandomState(3)
+        out = []
+        for i in range(n):
+            x = rs.randn(4).astype(np.float32)
+            if i in poison:
+                x = x * np.float32("nan")
+            out.append((x, rs.randn(2).astype(np.float32)))
+        return out
+
+    def test_fit_sentry_rolls_back_and_continues(self):
+        from paddle_tpu.hapi.callbacks import Callback
+
+        events = []
+
+        class Recorder(Callback):
+            def on_rollback(self, step, report=None):
+                events.append((step, report.code))
+
+        model = self._model()
+        sentry = DivergenceSentry(window=8, min_history=3,
+                                  spike_factor=50.0, snapshot_every=2,
+                                  ring_capacity=2, max_rollbacks=2)
+        model.fit(self._data(poison={5}), epochs=1, batch_size=1,
+                  verbose=0, shuffle=False, sentry=sentry,
+                  callbacks=[Recorder()])
+        assert sentry.anomalies == 1 and sentry.rollbacks == 1
+        assert sorted(sentry.blocklist) == [5]
+        assert events and events[0][0] == 5
+        assert events[0][1] & ANOMALY_NONFINITE_LOSS
+        w = np.asarray(model.network.state_dict()["weight"].numpy())
+        assert np.isfinite(w).all()
+
+    def test_fit_rollback_leaves_metric_accumulators_clean(self):
+        """A rolled-back batch must leave no trace in the prepared
+        metric accumulators: a NaN sample in a mean-style metric would
+        contaminate every later epoch log despite the rollback."""
+        from paddle_tpu.metric import Metric
+
+        class MeanOut(Metric):
+            def __init__(self):
+                self.samples = []
+
+            def name(self):
+                return "mean_out"
+
+            def compute(self, pred, label):
+                return float(np.asarray(pred.numpy()).mean())
+
+            def update(self, v):
+                self.samples.append(v)
+
+            def accumulate(self):
+                return float(np.mean(self.samples)) if self.samples \
+                    else 0.0
+
+            def reset(self):
+                self.samples = []
+
+        metric = MeanOut()
+        model = self._model()
+        model._metrics = [metric]
+        sentry = DivergenceSentry(window=8, min_history=3,
+                                  spike_factor=50.0, snapshot_every=2,
+                                  ring_capacity=2, max_rollbacks=2)
+        model.fit(self._data(poison={5}), epochs=1, batch_size=1,
+                  verbose=0, shuffle=False, sentry=sentry)
+        assert sentry.rollbacks == 1
+        assert len(metric.samples) == 9          # poisoned batch absent
+        assert np.isfinite(metric.samples).all()
+        assert np.isfinite(metric.accumulate())
+
+    def test_fit_rollback_clears_accumulated_grads(self):
+        """A poisoned NON-update micro-batch (accumulate_grad_batches=2)
+        leaves NaN in p.grad, which is not part of the snapshot — the
+        rollback must clear it or every later accumulation window stays
+        contaminated and a transient fault escalates."""
+        model = self._model()
+        sentry = DivergenceSentry(window=8, min_history=3,
+                                  spike_factor=50.0, snapshot_every=2,
+                                  ring_capacity=2, max_rollbacks=2)
+        model.fit(self._data(poison={4}), epochs=1, batch_size=1,
+                  verbose=0, shuffle=False, sentry=sentry,
+                  accumulate_grad_batches=2)
+        assert sentry.rollbacks == 1 and sentry.escalations == 0
+        w = np.asarray(model.network.state_dict()["weight"].numpy())
+        assert np.isfinite(w).all()
+        for p in model.network.parameters():
+            assert p.grad is None or np.isfinite(
+                np.asarray(p.grad.numpy())).all()
+
+    def test_fit_sentry_escalates(self):
+        model = self._model()
+        sentry = DivergenceSentry(window=8, min_history=3,
+                                  spike_factor=50.0, snapshot_every=2,
+                                  ring_capacity=2, max_rollbacks=0)
+        with pytest.raises(SentryEscalation) as ei:
+            model.fit(self._data(poison={4, 5, 6}), epochs=1,
+                      batch_size=1, verbose=0, shuffle=False,
+                      sentry=sentry)
+        assert ei.value.flight_dump["reason"] == "sentry_escalation"
+        assert sentry.escalations == 1 and sentry.rollbacks == 0
+
+    def test_fit_amp_scaler_state_in_step_generations(self, tmp_path):
+        """fit(save_steps=...) generations carry @scaler when a scaler
+        is prepared — the hapi half of the resume-payload audit."""
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+        scaler = GradScaler(init_loss_scaling=256.0)
+        model = self._model(scaler=scaler)
+        save_dir = str(tmp_path / "run")
+        model.fit(self._data(8), epochs=1, batch_size=2, verbose=0,
+                  shuffle=False, save_dir=save_dir, save_steps=2)
+        steps_root = ModelCheckpoint.steps_root(save_dir)
+        _, state = ckpt.load_generation(steps_root)
+        assert "@scaler" in state
+
+        scaler2 = GradScaler(init_loss_scaling=4.0)
+        m2 = self._model(scaler=scaler2)
+        assert m2.resume_from(steps_root) > 0
+        assert scaler2.get_loss_scaling() == scaler.get_loss_scaling()

@@ -149,6 +149,8 @@ TIER1_CRITICAL = {
         "Pallas paged-attention kernel parity vs the jnp reference",
     "tests/test_device_sampling.py":
         "on-device sampling parity vs the host oracle",
+    "tests/test_sentry.py":
+        "divergence-sentry detection/rollback and bitwise parity",
 }
 
 
