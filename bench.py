@@ -94,19 +94,30 @@ def preflight(max_attempts=None, timeouts=None, backoffs=None):
               f"{detail}", file=sys.stderr)
         if i + 1 < max_attempts:
             time.sleep(backoffs[min(i, len(backoffs) - 1)])
+    # a machine-parseable diagnostic (ISSUE 13): the BENCH_r03–r05 trail
+    # was three rounds of bare rc:1 before anyone could see the tunnel
+    # was down — error_kind makes "no number because no hardware"
+    # distinguishable from "no number because the bench broke"
     fail_structured(f"TPU backend unreachable after {max_attempts} "
-                    f"attempts (last: {last})")
+                    f"attempts (last: {last})",
+                    error_kind="backend_unreachable",
+                    attempts=max_attempts, last_probe=last)
 
 
 def fail_structured(msg: str,
-                    metric: str = "gpt2_345m_train_tokens_per_sec_per_chip"):
-    """One JSON line on stdout even on failure, then nonzero exit."""
+                    metric: str = "gpt2_345m_train_tokens_per_sec_per_chip",
+                    error_kind: str = "bench_failure", **extra):
+    """One JSON line on stdout even on failure, then nonzero exit.
+    ``error_kind`` classifies the failure machine-readably
+    (``backend_unreachable`` vs ``bench_failure``)."""
     print(json.dumps({
         "metric": metric,
         "value": 0.0,
         "unit": "tokens/s",
         "vs_baseline": 0.0,
         "error": msg,
+        "error_kind": error_kind,
+        **extra,
     }))
     sys.exit(1)
 
@@ -297,6 +308,7 @@ def _trace_replay(model):
     json.dumps(chrome)                   # Perfetto loads plain JSON
     trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR")
     if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
         obs.write_chrome_trace(
             tracer, os.path.join(trace_dir, "serving_trace.json"))
 
@@ -602,12 +614,20 @@ def _train_rollback_drill():
     fails structured otherwise — and emits the measured restore time as
     ``train_rollback_recovery_ms`` plus the sentry counters (pinned in
     tests/test_bench_smoke.py).  Runs the exact recovery path a 13B
-    multi-chip job would take, at toy scale."""
+    multi-chip job would take, at toy scale.
+
+    The drill also carries the training step observatory (ISSUE 13): a
+    ``StepTimeline`` records every attempt, the chain validator must
+    pass with the injected rollback present as a ``rolled_back`` span
+    in the Perfetto export (written to
+    ``$PADDLE_TPU_TRACE_DIR/train_trace.json`` when set), emitted as
+    ``train_step_trace_valid`` == 1.0."""
     import tempfile
 
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
+    from paddle_tpu import obs
     from paddle_tpu.distributed.fault_tolerance import (
         DivergenceSentry, FaultPlan, ResilientLoop, global_grad_norm)
 
@@ -634,6 +654,7 @@ def _train_rollback_drill():
         x = plan.corrupt_batch(step, rs.randn(4, 8).astype(np.float32))
         train_step(paddle.to_tensor(x))
 
+    timeline = obs.StepTimeline()
     with tempfile.TemporaryDirectory(prefix="bench_sentry_") as ckdir:
         loop = ResilientLoop(
             ckdir,
@@ -642,7 +663,7 @@ def _train_rollback_drill():
             restore_fn=lambda s: (net.set_state_dict(s["model"]),
                                   opt.set_state_dict(s["opt"])),
             save_every=None, save_final=False, sentry=sentry,
-            verbose=False)
+            verbose=False, timeline=timeline)
         loop.run(step_fn, 8)
     if sentry.rollbacks < 1 or sentry.anomalies < 1 \
             or loop.last_rollback_recovery_s is None:
@@ -652,18 +673,43 @@ def _train_rollback_drill():
     final = np.asarray(net.state_dict()["weight"].numpy())
     if not np.isfinite(final).all():
         fail_structured("sentry rollback drill left non-finite weights")
+
+    # -- step observatory (ISSUE 13): the drill's timeline must
+    # chain-validate and the rollback must be visible in the export
+    problems = obs.validate_timeline(timeline)
+    if problems:
+        fail_structured("train step timeline invalid: "
+                        + "; ".join(problems[:5]))
+    chrome = obs.chrome_trace(timeline)
+    rolled = [e for e in chrome["traceEvents"]
+              if e.get("ph") == "X"
+              and e.get("args", {}).get("state") == "rolled_back"]
+    if not rolled:
+        fail_structured("injected sentry rollback missing from the "
+                        "exported Perfetto trace")
+    json.dumps(chrome)                  # Perfetto loads plain JSON
+    trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        obs.write_chrome_trace(
+            timeline, os.path.join(trace_dir, "train_trace.json"))
     return {
         "train_rollback_recovery_ms": round(
             loop.last_rollback_recovery_s * 1e3, 3),
         "train_sentry_anomalies": sentry.anomalies,
         "train_sentry_rollbacks": sentry.rollbacks,
         "train_sentry_skipped_steps": sentry.skipped_steps,
+        # chain validator passed (checked above — reaching here IS the
+        # proof), rollback span present in the Perfetto export
+        "train_step_trace_valid": 1.0,
+        "train_step_trace_events": len(timeline.events),
     }
 
 
 def main():
     import os
     import jax
+    from paddle_tpu.obs import CompileLedger, CostLedger
 
     smoke = bool(os.environ.get("PADDLE_TPU_BENCH_SMOKE"))
     make_step, cfg, seq, model = build_bench(smoke=smoke)
@@ -671,24 +717,33 @@ def main():
     # linearly with no MFU gain (measured 0.418 @ 8 vs 0.387 @ 16)
     per_chip = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "8"))
 
+    # compile ledger (ISSUE 13): every executable-cache miss of the
+    # measured run is recorded — cumulative compile wall time becomes a
+    # reported metric, and a compile AFTER warmup (a steady-state miss)
+    # fails the bench as the named anomaly it is
+    ledger = CompileLedger(name="bench")
+    ledger.attach()
+
     def run_at(batch):
         train_step, x, y = make_step(batch)
         for _ in range(3):          # warmup (compile)
             loss = train_step(x, y)
         float(loss)
+        ledger.mark_steady()        # timed loop must add ZERO compiles
         n_iters = 10
         t0 = time.perf_counter()
         for _ in range(n_iters):
             loss = train_step(x, y)
         float(loss)  # sync
-        return (time.perf_counter() - t0) / n_iters, loss
+        return ((time.perf_counter() - t0) / n_iters, loss,
+                train_step, x, y)
 
     # halve the batch on OOM rather than failing the whole bench
-    dt = loss = None
+    dt = loss = train_step = None
     while per_chip >= 1:
         batch = per_chip * len(jax.devices())
         try:
-            dt, loss = run_at(batch)
+            dt, loss, train_step, x, y = run_at(batch)
             break
         except Exception as e:  # XlaRuntimeError RESOURCE_EXHAUSTED etc.
             if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" \
@@ -698,9 +753,14 @@ def main():
 
             print(f"bench: batch {per_chip}/chip OOM, halving",
                   file=sys.stderr)
+            ledger.reset_steady()   # retry at a new batch recompiles
             per_chip //= 2
     if dt is None:
         raise RuntimeError("bench could not fit even batch 1/chip")
+    ledger.detach()
+    if ledger.steady_state_misses:
+        fail_structured(
+            f"training steady state recompiled: {ledger.anomalies()}")
 
     n_chips = max(len(jax.devices()), 1)
     tokens_per_sec = batch * seq / dt / n_chips  # per-chip, honest on pods
@@ -709,8 +769,29 @@ def main():
     # bound convention used by the scaling literature)
     flops_per_token = 6.0 * n_params
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
-    # divergence-sentry recovery drill (ISSUE 12): enforced to actually
-    # roll back, priced separately from the throughput measurement
+
+    # cost/fingerprint ledger (ISSUE 13): XLA's own cost analysis of
+    # the EXACT program just timed — analytic roofline MFU, arithmetic
+    # intensity, and the schedule fingerprint.  The smoke path analyzes
+    # TWICE to prove the fingerprint is stable for identical programs
+    # (the regression surface the compute/collective-overlap work will
+    # move on purpose); the hardware path skips the re-analysis — each
+    # analyze is a full XLA lower+compile, seconds at 345M, and
+    # stability is already pinned every CI run in test_train_obs
+    cost = CostLedger()
+    rec = cost.add("train_step", train_step, x, y,
+                   tokens_per_step=batch * seq, n_params=n_params)
+    if smoke:
+        rec2 = cost.add("train_step", train_step, x, y,
+                        tokens_per_step=batch * seq, n_params=n_params)
+        if rec["fingerprint"] != rec2["fingerprint"]:
+            fail_structured(
+                f"schedule fingerprint unstable across identical "
+                f"analyses: {rec['fingerprint']} != {rec2['fingerprint']}")
+
+    # divergence-sentry recovery drill (ISSUE 12, step observatory
+    # ISSUE 13): enforced to actually roll back with a chain-valid
+    # step timeline, priced separately from the throughput measurement
     rollback = _train_rollback_drill()
     out = {
         "metric": "gpt2_345m_train_tokens_per_sec_per_chip",
@@ -720,6 +801,17 @@ def main():
         "mfu": round(mfu, 4),
         "step_ms": round(dt * 1000, 2),
         "loss": float(loss),
+        # compile ledger (ISSUE 13): how many XLA compiles the run paid
+        # and their cumulative wall seconds; the steady-state window
+        # added zero (enforced above — the run fails otherwise)
+        "train_compile_count": ledger.compiles,
+        "train_compile_seconds": round(ledger.total_seconds, 3),
+        # cost ledger (ISSUE 13): hardware-independent program facts
+        "train_analytic_mfu": rec["analytic_mfu"],
+        "train_arith_intensity": rec["arithmetic_intensity"],
+        "train_flops_vs_6nd": rec["flops_vs_6nd"],
+        "train_schedule_fingerprint": rec["fingerprint"],
+        "train_cost_chip": cost.chip,
         **rollback,
     }
     print(json.dumps(out))

@@ -59,6 +59,7 @@ from typing import Any, Callable, Dict, Optional
 
 from .. import checkpoint as ckpt
 from ...obs.flight import FlightRecorder
+from ...obs.train import StepTimeline, resolve_timeline
 from ..fleet.elastic.manager import ELASTIC_EXIT_CODE
 from .injection import FaultPlan
 from .memory_checkpoint import restore_packed_state
@@ -105,7 +106,10 @@ class ResilientLoop:
                  verbose: bool = True,
                  sentry: Optional[DivergenceSentry] = None,
                  scaler=None,
-                 flight_capacity: int = 256):
+                 flight_capacity: int = 256,
+                 timeline: Optional[StepTimeline] = None,
+                 compile_ledger=None,
+                 cost_ledger=None):
         if save_every is not None and save_every < 1:
             raise ValueError("save_every must be >= 1 (or None to disable)")
         if keep_last is not None and keep_last < 1:
@@ -131,8 +135,26 @@ class ResilientLoop:
         #: wall seconds the most recent rollback restore took (the
         #: bench's ``train_rollback_recovery_ms`` source)
         self.last_rollback_recovery_s: Optional[float] = None
+        #: step observatory (ISSUE 13): host-side per-step spans, off by
+        #: default (NULL_TIMELINE) unless passed or env-armed
+        #: (PADDLE_TPU_TRAIN_TRACE=1); the compile ledger subscribes to
+        #: executable-cache misses for the duration of run()
+        self.timeline = resolve_timeline(timeline)
+        self.compile_ledger = compile_ledger
+        #: an obs.CostLedger the caller fills (analyze the compiled
+        #: step once, post-warmup) — its analytic MFU / fingerprint
+        #: ride the train_stats()/metrics scrape surface
+        self.cost_ledger = cost_ledger
         self._preempt_sig: Optional[int] = None
         self._fault_plan = FaultPlan.from_env()
+        # join the profiler.train_stats() scrape surface only when
+        # something is armed (same contract as Model.fit): a bare loop
+        # would export an empty row per construction otherwise
+        if self.timeline.enabled or sentry is not None \
+                or compile_ledger is not None or cost_ledger is not None:
+            from ... import profiler as _profiler
+
+            _profiler._register_train_stats(self)
 
     # -- checkpoint plumbing --------------------------------------------
 
@@ -141,12 +163,13 @@ class ResilientLoop:
             print(f"[resilient] {msg}", file=sys.stderr)
 
     def _save(self, completed: int):
-        state = pack_state(self.state_fn(), completed,
-                           include_rng=self.include_rng,
-                           scaler=self.scaler)
-        t0 = time.monotonic()
-        ckpt.save_generation(state, self.ckpt_dir, completed,
-                             keep_last=self.keep_last)
+        with self.timeline.phase("checkpoint_commit"):
+            state = pack_state(self.state_fn(), completed,
+                               include_rng=self.include_rng,
+                               scaler=self.scaler)
+            t0 = time.monotonic()
+            ckpt.save_generation(state, self.ckpt_dir, completed,
+                                 keep_last=self.keep_last)
         self._log(f"committed generation {completed} "
                   f"({time.monotonic() - t0:.2f}s)")
 
@@ -172,11 +195,12 @@ class ResilientLoop:
     # -- memory tier / sentry -------------------------------------------
 
     def _mem_snapshot(self, completed: int):
-        state = pack_state(self.state_fn(), completed,
-                           include_rng=self.include_rng,
-                           scaler=self.scaler)
-        state["@sentry"] = self.sentry.state_dict()
-        self.sentry.ring.take(state)
+        with self.timeline.phase("snapshot_capture"):
+            state = pack_state(self.state_fn(), completed,
+                               include_rng=self.include_rng,
+                               scaler=self.scaler)
+            state["@sentry"] = self.sentry.state_dict()
+            self.sentry.ring.take(state)
 
     def _restore_newest_snapshot(self) -> Optional[int]:
         """Roll state back to the newest ring snapshot; returns its step
@@ -185,9 +209,10 @@ class ResilientLoop:
         if snap is None:
             return None
         t0 = time.monotonic()
-        step = restore_packed_state(
-            snap, self.restore_fn, scaler=self.scaler, sentry=self.sentry,
-            include_rng=self.include_rng)
+        with self.timeline.phase("rollback_restore"):
+            step = restore_packed_state(
+                snap, self.restore_fn, scaler=self.scaler,
+                sentry=self.sentry, include_rng=self.include_rng)
         self.last_rollback_recovery_s = time.monotonic() - t0
         return step
 
@@ -203,6 +228,7 @@ class ResilientLoop:
                   f"{report.flags() or [report.code]} after "
                   f"{self.sentry.rollbacks} rollback(s); flight dump "
                   f"frozen ({len(dump['events'])} steps)")
+        self.timeline.on_escalate(step)
         raise SentryEscalation(
             f"divergence sentry escalated at step {step} "
             f"(anomaly {report.flags() or report.code}; "
@@ -219,6 +245,23 @@ class ResilientLoop:
         if self.last_rollback_recovery_s is not None:
             out["last_rollback_recovery_ms"] = round(
                 self.last_rollback_recovery_s * 1e3, 3)
+        return out
+
+    def train_stats(self) -> dict:
+        """The training-observatory snapshot (ISSUE 13): timeline
+        counters, compile ledger, sentry/rollback counters — surfaced
+        process-wide through ``profiler.train_stats()`` and flattened
+        into the metrics exposition alongside the serving stacks."""
+        out: Dict[str, Any] = {"name": "training"}
+        if self.timeline.enabled:
+            out["timeline"] = self.timeline.counters()
+        if self.compile_ledger is not None:
+            out["compiles"] = self.compile_ledger.stats()
+        if self.cost_ledger is not None:
+            out["cost"] = self.cost_ledger.stats()
+        sen = self.sentry_stats()
+        if sen:
+            out["sentry"] = sen
         return out
 
     # -- preemption ------------------------------------------------------
@@ -273,9 +316,23 @@ class ResilientLoop:
         preemption signal arrived (after committing a final generation).
         With a sentry, anomalous steps roll back to the newest memory
         snapshot and are skipped on replay; ``step_fn`` is never called
-        for a blocklisted step."""
+        for a blocklisted step.
+
+        With a ``timeline`` the loop records one span per step attempt
+        (phases: ``step_dispatch`` around ``step_fn``, ``device_wait``
+        around the sentry poll, ``snapshot_capture`` /
+        ``checkpoint_commit`` / ``rollback_restore`` around their
+        owners; a ``data_fetch`` phase is the step function's to mark —
+        ``loop.timeline.phase("data_fetch")``).  With a
+        ``compile_ledger`` every executable-cache miss during the run
+        is recorded; the ledger flips to steady state after the first
+        completed step (a fixed-shape step has built everything by
+        then), so any later miss is a named anomaly."""
         start = self.resume()
         sentry = self.sentry
+        tl = self.timeline
+        if self.compile_ledger is not None:
+            self.compile_ledger.attach()
         watchdog = (StepWatchdog(self.watchdog_timeout,
                                  exit_code=self.exit_code,
                                  on_timeout=self._on_watchdog_timeout)
@@ -302,6 +359,7 @@ class ResilientLoop:
                 self._mem_snapshot(start)
             step = start
             while step < num_steps:
+                tl.begin_step(step)
                 skipped = sentry is not None and sentry.should_skip(step)
                 if skipped:
                     # blocklisted data window: step_fn is never called,
@@ -310,14 +368,17 @@ class ResilientLoop:
                     # (a cadence commit or SIGTERM landing exactly on a
                     # skipped step must not be silently dropped)
                     sentry.note_skip(step)
+                    tl.on_skip(step)
                     self._log(f"skipping blocklisted step {step}")
                 else:
                     if watchdog is not None:
                         watchdog.notify(step)
                     self._fault_plan.fire(step)
-                    step_fn(step)
+                    with tl.phase("step_dispatch"):
+                        step_fn(step)
                     if sentry is not None:
-                        report = sentry.poll()
+                        with tl.phase("device_wait"):
+                            report = sentry.poll()
                         if report.anomalous:
                             action = sentry.note_anomaly(step, report)
                             self.flight.record(step=step,
@@ -351,10 +412,21 @@ class ResilientLoop:
                                 f" at step {step}: rolled back to "
                                 f"snapshot {target} ({recovery_ms:.1f}ms)"
                                 f"; step {step} blocklisted")
+                            # ends the attempt span rolled_back; the
+                            # next begin_step becomes the rollback's
+                            # resume link (a Perfetto flow arrow)
+                            tl.on_rollback(step, target,
+                                           code=report.code)
                             step = target
                             continue
                         sentry.note_clean(step)
                 completed = step + 1
+                if not skipped and self.compile_ledger is not None \
+                        and not self.compile_ledger.steady:
+                    # one full step has executed: every program of a
+                    # fixed-shape step exists — later misses are named
+                    # steady-state anomalies
+                    self.compile_ledger.mark_steady()
                 if skipped:
                     self.flight.record(step=step, skipped=1)
                 elif sentry is not None:
@@ -369,6 +441,7 @@ class ResilientLoop:
                     _commit(completed)
                     self._log(f"preempted at step boundary {completed}; "
                               f"exiting {self.exit_code}")
+                    tl.end_step("skipped" if skipped else "completed")
                     raise SystemExit(self.exit_code)
                 if sentry is not None \
                         and completed % sentry.snapshot_every == 0:
@@ -377,6 +450,7 @@ class ResilientLoop:
                         and completed % self.save_every == 0 \
                         and completed < num_steps:
                     _commit(completed, resume_step=step)
+                tl.end_step("skipped" if skipped else "completed")
                 step += 1
             if self.save_final and num_steps > start:
                 _commit(num_steps)
@@ -385,5 +459,7 @@ class ResilientLoop:
         finally:
             if watchdog is not None:
                 watchdog.stop()
+            if self.compile_ledger is not None:
+                self.compile_ledger.detach()
             self._restore_handlers(saved_handlers)
         return completed

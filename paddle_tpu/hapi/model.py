@@ -14,6 +14,7 @@ import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
 from ..metric import Metric
+from ..obs.train import NULL_TIMELINE, resolve_timeline
 from . import callbacks as cbks_mod
 
 
@@ -32,6 +33,13 @@ class Model:
         #: batch); fit's rollback policy reads it instead of polling a
         #: second time
         self._last_sentry_report = None
+        # fit-level observatory surface (profiler.train_stats): live
+        # objects during fit, sentry frozen to bare counters after
+        # (holding the sentry would pin its snapshot ring)
+        self._fit_timeline = None
+        self._fit_sentry = None
+        self._fit_sentry_counters = None
+        self._obs_registered = False
 
     # -- configuration -----------------------------------------------------
 
@@ -65,7 +73,9 @@ class Model:
             return self._loss(*outs, *lbls)
         raise ValueError("Model.prepare(loss=...) required for training")
 
-    def train_batch(self, inputs, labels=None, update=True, sentry=None):
+    def train_batch(self, inputs, labels=None, update=True, sentry=None,
+                    timeline=None):
+        timeline = timeline if timeline is not None else NULL_TIMELINE
         self.network.train()
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         ins = [x if isinstance(x, Tensor) else to_tensor(x) for x in ins]
@@ -98,43 +108,51 @@ class Model:
                 self._optimizer.clear_grad()
             return outputs, loss
 
-        if self._amp_level in ("O1", "O2"):
-            from .. import amp as amp_mod
+        # step_dispatch covers building + dispatching the (possibly
+        # compiled) step — everything up to the first host pull; the
+        # device_wait phases below time the pulls themselves, so a
+        # timeline separates "host built the step" from "host waited
+        # on the device" per batch
+        with timeline.phase("step_dispatch"):
+            if self._amp_level in ("O1", "O2"):
+                from .. import amp as amp_mod
 
-            with amp_mod.auto_cast(level=self._amp_level):
-                outputs = self.network(*ins)
-            loss = self._compute_loss(outputs, labels)
-            if scaler is not None:
-                scaler.scale(loss).backward()
-                if update:
-                    scaler.unscale_(self._optimizer)
-                    _observe(loss, grads_ready=True,
-                             found_inf=scaler.found_inf)
-                    scaler.step(self._optimizer)
-                    scaler.update()
-                    self._optimizer.clear_grad()
+                with amp_mod.auto_cast(level=self._amp_level):
+                    outputs = self.network(*ins)
+                loss = self._compute_loss(outputs, labels)
+                if scaler is not None:
+                    scaler.scale(loss).backward()
+                    if update:
+                        scaler.unscale_(self._optimizer)
+                        _observe(loss, grads_ready=True,
+                                 found_inf=scaler.found_inf)
+                        scaler.step(self._optimizer)
+                        scaler.update()
+                        self._optimizer.clear_grad()
+                    else:
+                        _observe(loss, grads_ready=False)
                 else:
-                    _observe(loss, grads_ready=False)
+                    loss.backward()
+                    _observe(loss, grads_ready=update)
+                    if update:
+                        self._optimizer.step()
+                        self._optimizer.clear_grad()
             else:
-                loss.backward()
-                _observe(loss, grads_ready=update)
-                if update:
-                    self._optimizer.step()
-                    self._optimizer.clear_grad()
-        else:
-            outputs, loss = _run()
+                outputs, loss = _run()
         self._last_sentry_report = None
         if sentry is not None:
             # poll HERE (still the one pull per batch — fit reads
             # _last_sentry_report instead of polling again) so an
             # anomalous batch never reaches the metric accumulators:
             # a rolled-back batch must leave no trace in them either
-            self._last_sentry_report = sentry.poll()
+            with timeline.phase("device_wait"):
+                self._last_sentry_report = sentry.poll()
             if self._last_sentry_report.anomalous:
                 # the polled report already holds the loss host-side —
                 # no second device pull on the rollback path
                 return [self._last_sentry_report.loss]
-        metrics = [float(np.asarray(loss.numpy()))]
+        with timeline.phase("device_wait"):
+            metrics = [float(np.asarray(loss.numpy()))]
         for m in self._metrics:
             pre = m.compute(outputs if not isinstance(outputs, (list, tuple))
                             else outputs[0],
@@ -208,8 +226,18 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, save_steps=None,
-            keep_last=3, resume=False, sentry=None):
+            keep_last=3, resume=False, sentry=None, timeline=None):
         """Train the prepared model (reference `hapi/model.py:1574`).
+
+        ``timeline`` (an ``obs.StepTimeline``) arms the training step
+        observatory: one span per batch attempt with ``data_fetch`` /
+        ``step_dispatch`` / ``device_wait`` / ``snapshot_capture``
+        phases, sentry rollbacks ended ``rolled_back`` and linked to
+        the batch that resumed — export with ``obs.chrome_trace`` /
+        ``obs.jsonl_lines`` and certify with ``obs.validate_timeline``.
+        Pure host-side timing: no new compile keys, no device pulls
+        (defaults to the no-op ``NULL_TIMELINE``; or set
+        ``PADDLE_TPU_TRAIN_TRACE=1``).
 
         ``sentry`` (a ``distributed.fault_tolerance.DivergenceSentry``)
         arms divergence rollback: each batch is checked by the in-graph
@@ -246,15 +274,52 @@ class Model:
             "epochs": epochs, "steps": steps, "verbose": verbose,
             "batch_size": batch_size, "metrics": self._metrics_name(),
         })
+        # same arming contract as ResilientLoop: explicit timeline=,
+        # else PADDLE_TPU_TRAIN_TRACE=1, else the no-op
+        tl = resolve_timeline(timeline)
+        # an armed fit joins profiler.train_stats() / the metrics
+        # exposition like a ResilientLoop does (register once per
+        # Model; the snapshot reads whatever the LAST ARMED fit set —
+        # a later unarmed fit must not wipe it mid-scrape).  A sentry
+        # alone is enough to register: its rollback counters must be
+        # scrapable even when step timing is off
+        if tl.enabled or sentry is not None:
+            self._fit_timeline = tl if tl.enabled else None
+            self._fit_sentry = sentry
+            if not self._obs_registered:
+                from .. import profiler as _profiler
+
+                _profiler._register_train_stats(self)
+                self._obs_registered = True
         flight = None
         gstep = int(self._resumed_step or 0)
         if sentry is not None:
             from ..obs.flight import FlightRecorder
 
             flight = FlightRecorder(name="training")
-            self._sentry_snapshot(sentry, gstep)   # seed a rollback target
+            # seed a rollback target (a background snapshot_capture
+            # phase — no batch attempt is open yet)
+            self._sentry_snapshot(sentry, gstep, timeline=tl)
         self.stop_training = False
         cbk_list.on_train_begin()
+        try:
+            self._fit_epochs(epochs, train_loader, eval_loader, cbk_list,
+                             sentry, tl, flight, gstep, batch_size,
+                             eval_freq, accumulate_grad_batches,
+                             num_iters)
+        finally:
+            # the scrape surface only needs the sentry's COUNTERS; a
+            # live reference would pin its snapshot ring (several full
+            # model+optimizer state copies) for the Model's lifetime
+            if sentry is not None and self._fit_sentry is sentry:
+                self._fit_sentry = None
+                self._fit_sentry_counters = dict(sentry.counters())
+        cbk_list.on_train_end()
+        return self
+
+    def _fit_epochs(self, epochs, train_loader, eval_loader, cbk_list,
+                    sentry, tl, flight, gstep, batch_size, eval_freq,
+                    accumulate_grad_batches, num_iters):
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -263,17 +328,30 @@ class Model:
                 m.reset()
             logs = {}
             step_count = 0
-            for step, batch in enumerate(train_loader):
+            batches = enumerate(train_loader)
+            while True:
+                # one span per batch attempt; the fetch itself is the
+                # data_fetch phase (a starved input pipeline becomes
+                # visible as exactly that)
+                tl.begin_step(gstep)
+                with tl.phase("data_fetch"):
+                    try:
+                        step, batch = next(batches)
+                    except StopIteration:
+                        tl.abandon_step()   # nothing ran this attempt
+                        break
                 if sentry is not None and sentry.should_skip(gstep):
                     # skip only bypasses the batch itself: the boundary
                     # still flows through the flight ring and the
                     # snapshot cadence (a cadence landing exactly on a
                     # skipped step must not shrink the rollback window)
                     sentry.note_skip(gstep)
+                    tl.on_skip(gstep)
                     flight.record(step=gstep, skipped=1)
                     gstep += 1
                     if gstep % sentry.snapshot_every == 0:
-                        self._sentry_snapshot(sentry, gstep)
+                        self._sentry_snapshot(sentry, gstep, timeline=tl)
+                    tl.end_step("skipped")
                     step_count += 1
                     if num_iters is not None and step_count >= num_iters:
                         break
@@ -281,7 +359,8 @@ class Model:
                 cbk_list.on_train_batch_begin(step)
                 x, y = self._unpack(batch)
                 update = ((step + 1) % accumulate_grad_batches == 0)
-                outs = self.train_batch(x, y, update=update, sentry=sentry)
+                outs = self.train_batch(x, y, update=update, sentry=sentry,
+                                        timeline=tl)
                 if sentry is not None:
                     report = self._last_sentry_report
                     flight.record(step=gstep, anomaly=report.code,
@@ -290,7 +369,8 @@ class Model:
                                   scale=report.scale)
                     if report.anomalous:
                         self._sentry_rollback(sentry, gstep, report,
-                                              cbk_list, flight)
+                                              cbk_list, flight,
+                                              timeline=tl)
                         gstep += 1
                         step_count += 1
                         if num_iters is not None \
@@ -305,16 +385,15 @@ class Model:
                 gstep += 1
                 if sentry is not None \
                         and gstep % sentry.snapshot_every == 0:
-                    self._sentry_snapshot(sentry, gstep)
+                    self._sentry_snapshot(sentry, gstep, timeline=tl)
+                tl.end_step("completed")
                 step_count += 1
                 if num_iters is not None and step_count >= num_iters:
                     break
             cbk_list.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, batch_size=batch_size,
-                                          verbose=0, _callbacks=cbk_list)
-        cbk_list.on_train_end()
-        return self
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=0, _callbacks=cbk_list)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None,
@@ -402,12 +481,28 @@ class Model:
 
     # -- divergence sentry (fit-level policy) ----------------------------------
 
-    def _sentry_snapshot(self, sentry, gstep):
-        state = self._ft_state_dict(gstep)
-        state["@sentry"] = sentry.state_dict()
-        sentry.ring.take(state)
+    def train_stats(self) -> dict:
+        """The fit-level observatory snapshot (armed by
+        ``fit(timeline=...)`` / ``PADDLE_TPU_TRAIN_TRACE=1``), surfaced
+        through ``profiler.train_stats()`` alongside ResilientLoop
+        runs."""
+        out = {"name": "fit"}
+        if self._fit_timeline is not None:
+            out["timeline"] = self._fit_timeline.counters()
+        if self._fit_sentry is not None:          # live (mid-fit)
+            out["sentry"] = self._fit_sentry.counters()
+        elif self._fit_sentry_counters is not None:   # frozen post-fit
+            out["sentry"] = self._fit_sentry_counters
+        return out
 
-    def _sentry_rollback(self, sentry, gstep, report, cbk_list, flight):
+    def _sentry_snapshot(self, sentry, gstep, timeline=None):
+        with (timeline or NULL_TIMELINE).phase("snapshot_capture"):
+            state = self._ft_state_dict(gstep)
+            state["@sentry"] = sentry.state_dict()
+            sentry.ring.take(state)
+
+    def _sentry_rollback(self, sentry, gstep, report, cbk_list, flight,
+                         timeline=None):
         """Fit-level anomaly policy: restore the newest ring snapshot
         and move on to the next batch (the offending window is skipped,
         never replayed); escalate after ``max_rollbacks`` consecutive
@@ -415,6 +510,7 @@ class Model:
         from ..distributed.fault_tolerance import (
             SentryEscalation, restore_packed_state)
 
+        tl = timeline or NULL_TIMELINE
         action = sentry.note_anomaly(gstep, report)
         if action == "escalate":
             # leave the live model restored to the newest good snapshot
@@ -422,17 +518,21 @@ class Model:
             # ResilientLoop._escalate
             snap = sentry.ring.newest()
             if snap is not None:
-                restore_packed_state(snap, self._ft_restore,
-                                     scaler=self._scaler, sentry=sentry)
+                with tl.phase("rollback_restore"):
+                    restore_packed_state(snap, self._ft_restore,
+                                         scaler=self._scaler,
+                                         sentry=sentry)
             dump = flight.dump("sentry_escalation")
+            tl.on_escalate(gstep)
             raise SentryEscalation(
                 f"divergence sentry escalated at fit step {gstep} "
                 f"(anomaly {report.flags() or report.code}; "
                 f"{sentry.max_rollbacks} consecutive rollbacks exhausted)",
                 step=gstep, report=report, flight_dump=dump)
         snap = sentry.ring.newest()
-        restore_packed_state(snap, self._ft_restore, scaler=self._scaler,
-                             sentry=sentry)
+        with tl.phase("rollback_restore"):
+            restore_packed_state(snap, self._ft_restore,
+                                 scaler=self._scaler, sentry=sentry)
         if self._optimizer is not None:
             # grads accumulated from the poisoned batch (including a
             # non-update micro-batch under accumulate_grad_batches)
@@ -440,6 +540,10 @@ class Model:
             # keeps contaminating every later accumulation window
             self._optimizer.clear_grad()
         sentry.rollbacks += 1
+        # the rollback ends this batch's attempt span (fit skips
+        # forward, so there is no replay target step to point at — the
+        # resume link lands on the next batch attempt)
+        tl.on_rollback(gstep, code=report.code)
         # on_rollback IS the terminal event for this batch: the matching
         # on_train_batch_end deliberately does not fire (the batch's
         # effects were rolled back — per-batch-end hooks like LR

@@ -8,8 +8,11 @@ cache (program_translator.py:239,772) with jax.jit as the executor.
 from __future__ import annotations
 
 import functools
+import hashlib
 import os
-from typing import Any, List, Optional
+import time
+import traceback
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 import jax
@@ -30,6 +33,82 @@ _TRACER_LEAK_ERRORS = tuple(
                           "TracerIntegerConversionError",
                           "ConcretizationTypeError"))
     if e is not None)
+
+
+# -- executable-cache miss subscription (ISSUE 13) --------------------------
+# Every StaticFunction program-cache miss is one trace + one XLA compile.
+# Listeners (obs.CompileLedger) subscribe here to turn each miss into a
+# ledger record — cache key, wall seconds, arg specs, attributed call
+# site — so steady-state misses become NAMED anomalies instead of a
+# mystery latency spike.  With no listener attached the miss path pays
+# one falsy check and nothing else.
+
+_compile_listeners: List[Callable[[dict], None]] = []
+
+
+def subscribe_compiles(listener: Callable[[dict], None]) -> None:
+    """Register ``listener(record)`` for every program-cache miss
+    (see :class:`paddle_tpu.obs.compile_ledger.CompileLedger` — the
+    canonical consumer).  Idempotent per listener object."""
+    if listener not in _compile_listeners:
+        _compile_listeners.append(listener)
+
+
+def unsubscribe_compiles(listener: Callable[[dict], None]) -> None:
+    try:
+        _compile_listeners.remove(listener)
+    except ValueError:
+        pass
+
+
+def _compile_call_site() -> str:
+    """The innermost stack frame OUTSIDE the framework — who asked for
+    this compile.  Only runs on a miss (compiles are seconds; a stack
+    walk is microseconds)."""
+    here = os.sep + "paddle_tpu" + os.sep
+    for fr in reversed(traceback.extract_stack()):
+        fn = fr.filename
+        if here in fn or (os.sep + "jax" + os.sep) in fn:
+            continue
+        return f"{fn}:{fr.lineno}"
+    return "<framework>"
+
+
+def _arg_specs_str(leaves: List[Tensor]) -> str:
+    return ",".join(f"{t.dtype}[{','.join(str(s) for s in t.shape)}]"
+                    for t in leaves)
+
+
+def _notify_compile(static_fn, key, leaves, seconds: float,
+                    executed: bool) -> None:
+    prog = static_fn._programs.get(key)
+    rec = {
+        "fn": getattr(static_fn._fn, "__qualname__",
+                      getattr(static_fn._fn, "__name__", "<fn>")),
+        "key": hashlib.sha1(repr(key).encode()).hexdigest()[:12],
+        "arg_specs": _arg_specs_str(leaves),
+        "seconds": round(seconds, 6),
+        "site": _compile_call_site(),
+        "cache_size": len(static_fn._programs),
+        "state_inputs": len(prog.state_keys) if prog is not None else 0,
+        # False = trace-only (get_concrete_program: eval_shape discovery,
+        # no XLA executable built yet — jax.jit compiles lazily at the
+        # first real call)
+        "executed": executed,
+    }
+    for cb in list(_compile_listeners):
+        try:
+            cb(rec)
+        except Exception as e:  # noqa: BLE001 — observers must never
+            # break the compile path (or, from the notify-in-finally,
+            # mask the first call's REAL exception — e.g. the
+            # RESOURCE_EXHAUSTED the bench's OOM-halving matches on)
+            import sys
+            import traceback as _tb
+
+            print(f"paddle_tpu.jit: compile listener {cb!r} raised "
+                  f"{type(e).__name__}: {e} (ignored)", file=sys.stderr)
+            _tb.print_exc(file=sys.stderr)
 
 
 def _build_mapped(prog, leaves):
@@ -127,8 +206,24 @@ class StaticFunction:
         if prog is None:
             prog = CompiledProgram(self._fn, args_tree, kwargs_tree,
                                    donate=self._donate)
+            # time trace + build + the FIRST call (jax.jit compiles
+            # lazily, so the first execution pays the XLA compile —
+            # that wall time is the ledger's whole point); one miss
+            # path whether or not a listener is attached.  Notify in
+            # finally: a first call that raises still CACHED the
+            # program, and the retry will be a silent hit — skipping
+            # the record would undercount that key's compile forever
+            t0 = time.perf_counter()
             _build_mapped(prog, leaves)
             self._programs[key] = prog
+            try:
+                out = prog(leaves)
+            finally:
+                if _compile_listeners:
+                    _notify_compile(self, key, leaves,
+                                    time.perf_counter() - t0,
+                                    executed=True)
+            return out
         return prog(leaves)
 
     def concrete_program_specify_input_spec(self, input_spec=None):
@@ -149,8 +244,12 @@ class StaticFunction:
         if prog is None:
             prog = CompiledProgram(self._fn, args_tree, kwargs_tree,
                                    donate=self._donate)
+            t0 = time.perf_counter()
             _build_mapped(prog, leaves)
             self._programs[key] = prog
+            if _compile_listeners:
+                _notify_compile(self, key, leaves,
+                                time.perf_counter() - t0, executed=False)
         return prog
 
     def rollback(self):

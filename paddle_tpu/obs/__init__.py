@@ -1,4 +1,4 @@
-"""paddle_tpu.obs — observability exporters for the serving stack.
+"""paddle_tpu.obs — observability for the serving AND training stacks.
 
 A thin, dependency-free export layer over
 :class:`paddle_tpu.serving.tracing.RequestTracer` and the
@@ -25,15 +25,36 @@ engine, a traced value, or a compiled program.
 bounded step-summary ring both the serving engine and the training
 runtime feed (frozen into a post-mortem dump on unhealthy/eject/
 sentry-escalation/watchdog events).
+
+The **training step observatory** (ISSUE 13) lives here too:
+
+- :class:`~.train.StepTimeline` / :func:`~.train.validate_timeline` —
+  host-side per-step spans (data fetch, dispatch, device wait,
+  snapshot/checkpoint, sentry rollback/skip), rendered by the SAME
+  Perfetto/JSONL exporters (process ``trainer``, one thread per phase,
+  rollbacks as flow arrows);
+- :class:`~.compile_ledger.CompileLedger` — every executable-cache
+  miss recorded with cache key, wall seconds, arg specs, and call
+  site, so a steady-state recompile is a named anomaly;
+- :class:`~.hlo_cost.CostLedger` — XLA cost analysis per compiled
+  program (flops, bytes, HLO op mix, analytic roofline MFU) plus the
+  stable schedule fingerprint — the CPU-verifiable surface the
+  compute/collective-overlap work will be asserted on.
 """
 from .flight import FlightRecorder  # noqa: F401
 from .perfetto import chrome_trace, write_chrome_trace  # noqa: F401
 from .jsonl import jsonl_lines, write_jsonl  # noqa: F401
 from .metrics import render_metrics, render_all_metrics  # noqa: F401
+from .train import (NULL_TIMELINE, StepTimeline,  # noqa: F401
+                    validate_timeline)
+from .compile_ledger import CompileLedger  # noqa: F401
+from .hlo_cost import CostLedger  # noqa: F401
 
 __all__ = ["FlightRecorder", "chrome_trace", "write_chrome_trace",
            "jsonl_lines", "write_jsonl", "render_metrics",
-           "render_all_metrics", "validate_trace"]
+           "render_all_metrics", "validate_trace", "StepTimeline",
+           "NULL_TIMELINE", "validate_timeline", "CompileLedger",
+           "CostLedger"]
 
 
 def __getattr__(name):

@@ -16,8 +16,12 @@ label, and everything else is skipped.
     # paddle_tpu_serving_requests_completed{engine="r0"} 12
     ...
 
-:func:`render_all_metrics` walks every live engine and fleet through
-``paddle_tpu.profiler`` — the process-wide ``/metrics`` endpoint body.
+:func:`render_all_metrics` walks every live engine, fleet, AND training
+loop through ``paddle_tpu.profiler`` — the process-wide ``/metrics``
+endpoint body: ONE exposition covers both stacks (serving snapshots
+under ``paddle_tpu_serving*``, the training observatory — timeline
+counters, compile ledger, cost ledger, sentry counters — under
+``paddle_tpu_train``).
 """
 from __future__ import annotations
 
@@ -82,7 +86,8 @@ def render_metrics(snapshot: dict, *, prefix: str = "paddle_tpu_serving",
             lines.append(f"{_metric_name(prefix, *path)}{lab} {v}")
         elif isinstance(v, str) and path and path[-1] in (
                 "state", "engine_state", "replica_state",
-                "kv_block_invariants", "kv_layout"):
+                "kv_block_invariants", "kv_layout",
+                "fingerprint", "chip", "bound"):
             name = _metric_name(prefix, *path) + "_info"
             il = _labels({**labels, "value": v})
             lines.append(f"{name}{il} 1")
@@ -90,8 +95,11 @@ def render_metrics(snapshot: dict, *, prefix: str = "paddle_tpu_serving",
 
 
 def render_all_metrics(prefix: str = "paddle_tpu_serving") -> str:
-    """The process-wide ``/metrics`` body: every live engine's and
-    fleet's snapshot, flattened (via ``paddle_tpu.profiler``)."""
+    """The process-wide ``/metrics`` body: every live engine's,
+    fleet's, and training loop's snapshot, flattened (via
+    ``paddle_tpu.profiler``).  Training metrics render under the
+    ``paddle_tpu_train`` prefix regardless of ``prefix`` (one scrape
+    covers both stacks without name collisions)."""
     from .. import profiler
 
     chunks = []
@@ -101,4 +109,7 @@ def render_all_metrics(prefix: str = "paddle_tpu_serving") -> str:
     for name, snap in profiler.serving_fleet().items():
         chunks.append(render_metrics(snap, prefix=prefix + "_fleet",
                                      labels={"fleet": name}))
+    for name, snap in profiler.train_stats().items():
+        chunks.append(render_metrics(snap, prefix="paddle_tpu_train",
+                                     labels={"loop": name}))
     return "".join(chunks)

@@ -1,4 +1,4 @@
-"""Chrome/Perfetto trace-event export of a serving RequestTracer.
+"""Chrome/Perfetto trace-event export of a span/event recorder.
 
 Produces the classic ``{"traceEvents": [...]}`` JSON the Perfetto UI
 (https://ui.perfetto.dev) and ``chrome://tracing`` load directly:
@@ -15,6 +15,15 @@ Produces the classic ``{"traceEvents": [...]}`` JSON the Perfetto UI
   cross-replica story reads as connected arrows;
 - a per-replica **counter track** (``active_slots``) fed by the batched
   per-step decode events.
+
+The SAME renderer exports a training
+:class:`~paddle_tpu.obs.train.StepTimeline` (ISSUE 13): the timeline's
+spans carry a ``thread`` *name* instead of a slot number, so a training
+run renders as one ``trainer`` process with one named thread per phase
+(``step``, ``data_fetch``, ``step_dispatch``, ``device_wait``, ...),
+step attempts as complete events, and sentry ``rollback`` events as
+flow arrows into the attempt that resumed from them — the recovery
+reads exactly like a serving preempt/resume pair.
 
 Timestamps are the tracer's monotonic event clock in microseconds
 (Perfetto needs only relative time); the tracer's wall-clock anchor is
@@ -46,6 +55,10 @@ class _Tracks:
         self.out = out
         self.pids: Dict[str, int] = {}
         self._named_threads = set()
+        # name-keyed thread tracks (training phase threads): allocated
+        # from 100 per process, clear of the slot-indexed tids
+        self._by_name: Dict[tuple, int] = {}
+        self._name_next: Dict[int, int] = {}
 
     def pid(self, replica: Optional[str]) -> int:
         if replica is None:
@@ -64,8 +77,19 @@ class _Tracks:
                              "args": {"name": replica}})
         return p
 
-    def tid(self, replica: Optional[str], slot: Optional[int]) -> int:
+    def tid(self, replica: Optional[str], slot: Optional[int],
+            thread: Optional[str] = None) -> int:
         p = self.pid(replica)
+        if thread is not None:
+            t = self._by_name.get((p, thread))
+            if t is None:
+                t = self._name_next.get(p, 100)
+                self._name_next[p] = t + 1
+                self._by_name[(p, thread)] = t
+                self.out.append({"ph": "M", "name": "thread_name",
+                                 "pid": p, "tid": t,
+                                 "args": {"name": thread}})
+            return t
         t = SCHEDULER_TID if slot is None else int(slot) + 1
         key = (p, t)
         if key not in self._named_threads:
@@ -87,7 +111,7 @@ def chrome_trace(tracer) -> dict:
     for sid, sp in sorted(tracer.spans.items()):
         t_end = sp["t_end"] if sp["t_end"] is not None else sp["t_start"]
         pid = tracks.pid(sp["replica"])
-        tid = tracks.tid(sp["replica"], sp["slot"])
+        tid = tracks.tid(sp["replica"], sp.get("slot"), sp.get("thread"))
         out.append({
             "ph": "X", "pid": pid, "tid": tid,
             "ts": _us(sp["t_start"]),
@@ -108,18 +132,20 @@ def chrome_trace(tracer) -> dict:
                         "args": {"active": ev["n_active"]}})
             continue
         sp = tracer.spans.get(ev.get("span"))
-        slot = sp["slot"] if sp is not None else None
+        slot = sp.get("slot") if sp is not None else None
+        thread = ev.get("thread") or (sp.get("thread") if sp else None)
         pid = tracks.pid(replica)
-        tid = tracks.tid(replica, slot)
+        tid = tracks.tid(replica, slot, thread)
         args = {k: v for k, v in ev.items()
-                if k not in ("ts", "kind", "span")}
+                if k not in ("ts", "kind", "span", "thread")}
         out.append({"ph": "i", "s": "t", "pid": pid, "tid": tid,
                     "ts": _us(ev["ts"]), "name": kind, "cat": kind,
                     "args": args})
         # linked-span flow arrows: preempt -> resume span start,
-        # redispatch -> the replayed attempt span start
+        # redispatch -> the replayed attempt span start, training
+        # rollback -> the attempt that resumed from the snapshot
         target = None
-        if kind == "preempt":
+        if kind in ("preempt", "rollback"):
             target = tracer.spans.get(ev.get("resume_span"))
         elif kind == "redispatch":
             target = tracer.spans.get(ev.get("attempt_span"))
@@ -130,7 +156,8 @@ def chrome_trace(tracer) -> dict:
             out.append({"ph": "f", "bp": "e", "id": flow_id,
                         "pid": tracks.pid(target["replica"]),
                         "tid": tracks.tid(target["replica"],
-                                          target["slot"]),
+                                          target.get("slot"),
+                                          target.get("thread")),
                         "ts": _us(target["t_start"]), "name": kind,
                         "cat": "link"})
     return {

@@ -98,6 +98,42 @@ def _register_fleet_metrics(m) -> None:
     _fleet_metrics.append(_weakref.ref(m))
 
 
+# -- training observatory (ISSUE 13) ----------------------------------------
+# ResilientLoop registers itself here (weakly) at construction; its
+# train_stats() snapshot carries the step-timeline counters, the compile
+# ledger, and the sentry/rollback counters.
+
+_train_stats: "list" = []
+
+
+def _register_train_stats(obj) -> None:
+    _train_stats.append(_weakref.ref(obj))
+
+
+def train_stats() -> dict:
+    """Snapshot of every live training loop's observatory
+    (step-timeline counters, compile ledger — ``["compiles"]`` — and
+    divergence-sentry/rollback counters), keyed by loop name (suffixed
+    ``#2``... when several loops share one).  The training analog of
+    :func:`serving_stats`; flattened into the process-wide metrics
+    exposition by ``obs.render_all_metrics``."""
+    out, live = {}, []
+    for ref in _train_stats:
+        o = ref()
+        if o is None:
+            continue
+        live.append(ref)
+        snap = o.train_stats()
+        name = snap.get("name", "training")
+        key, i = name, 1
+        while key in out:
+            i += 1
+            key = f"{name}#{i}"
+        out[key] = snap
+    _train_stats[:] = live
+    return out
+
+
 _flight_recorders: "list" = []
 
 

@@ -33,6 +33,21 @@ def test_smoke_mode_emits_json_line():
     assert out["train_sentry_anomalies"] >= 1
     assert out["train_sentry_rollbacks"] >= 1
     assert out["train_sentry_skipped_steps"] >= 1
+    # training step observatory (ISSUE 13): the compile ledger saw the
+    # bench's own compile (and the steady-state window added zero —
+    # bench.py exits nonzero otherwise), the cost ledger produced an
+    # analytic roofline MFU + a schedule fingerprint stable across two
+    # identical analyses, and the rollback drill's step timeline
+    # chain-validated with the rollback span present in the Perfetto
+    # export
+    assert out["train_compile_count"] >= 1
+    assert out["train_compile_seconds"] > 0
+    assert 0 < out["train_analytic_mfu"] <= 1.0
+    assert out["train_arith_intensity"] > 0
+    assert out["train_flops_vs_6nd"] > 0
+    assert len(out["train_schedule_fingerprint"]) == 16
+    assert out["train_step_trace_valid"] == 1.0
+    assert out["train_step_trace_events"] > 0
 
 
 @pytest.mark.slow
@@ -117,6 +132,12 @@ def test_preflight_failure_is_structured():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert "error" in out and "unreachable" in out["error"]
     assert out["value"] == 0.0
+    # ISSUE 13: the BENCH_r03–r05 rc:1 trail is no longer silent — an
+    # unreachable backend is a machine-parseable diagnostic class,
+    # distinguishable from a bench bug
+    assert out["error_kind"] == "backend_unreachable"
+    assert out["attempts"] == 2
+    assert "last_probe" in out
 
 
 def test_probe_timeout_is_bounded():

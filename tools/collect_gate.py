@@ -151,6 +151,8 @@ TIER1_CRITICAL = {
         "on-device sampling parity vs the host oracle",
     "tests/test_sentry.py":
         "divergence-sentry detection/rollback and bitwise parity",
+    "tests/test_train_obs.py":
+        "training step observatory (timeline/compile/cost ledgers)",
 }
 
 

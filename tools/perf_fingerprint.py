@@ -25,7 +25,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -38,20 +37,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# one HLO instruction per line: `%name = <type> opcode(...)`
-_INSTR = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.-]+ = .+? ([\w-]+)\(")
-_OPS = ("dot", "fusion", "custom-call", "all-reduce", "all-gather",
-        "reduce-scatter", "collective-permute", "all-to-all", "while",
-        "convolution")
-
-
-def _count_ops(hlo: str) -> dict:
-    counts = {op.replace("-", "_"): 0 for op in _OPS}
-    for line in hlo.splitlines():
-        m = _INSTR.match(line)
-        if m and m.group(1) in _OPS:
-            counts[m.group(1).replace("-", "_")] += 1
-    return counts
+# HLO op counting is shared with the runtime cost ledger (ISSUE 13:
+# paddle_tpu/obs/hlo_cost.py generalizes this tool's one-shot logic into
+# the per-executable CostLedger) — importing it here means the tracked
+# artifact and the ledger can never count ops differently
+from paddle_tpu.obs.hlo_cost import count_hlo_ops as _count_ops  # noqa: E402
+from paddle_tpu.obs.hlo_cost import schedule_fingerprint  # noqa: E402
 
 
 def fingerprint(smoke: bool, batch: int) -> dict:
@@ -85,6 +76,9 @@ def fingerprint(smoke: bool, batch: int) -> dict:
         "n_params": n_params,
         "cost": cost,
         "hlo_counts": counts,
+        # opcode-sequence digest (obs.hlo_cost): the schedule surface
+        # the compute/collective-overlap work will be asserted on
+        "schedule_fingerprint": schedule_fingerprint(hlo),
         "memory": {k: v for k, v in stats.items()},
         "jax_version": jax.__version__,
     }

@@ -1,9 +1,10 @@
-"""Decompose the 345M bench step's 195 ms by ablation on the real chip.
+"""Decompose the bench step — on hardware by wall timing, or OFFLINE
+by XLA cost analysis when no TPU is reachable.
 
 mxu_probe.py (round 5, fixed timing) shows every GEMM family of the
 compiled step sustains 85-99% MXU standalone, refuting the r3 "matmuls
 at 55%" reading — so the step's gap to the ~79 ms GEMM-ideal lives
-elsewhere.  This tool measures, on hardware:
+elsewhere.  On hardware this tool measures:
 
   full      loss + backward + AdamW      (the exact bench step)
   fwd_bwd   loss + backward, no opt      (full - fwd_bwd = optimizer)
@@ -14,7 +15,19 @@ elsewhere.  This tool measures, on hardware:
 Timing: 10 python-loop calls with one final sync (step >> RPC floor);
 flash standalone uses the mxu_probe slope method.
 
-Usage: PYTHONPATH=/root/.axon_site:/root/repo python tools/step_ablation.py
+**Offline mode** (``--offline``, or automatic when ``JAX_PLATFORMS``
+is cpu — the state the driver bench has been stuck in since r03):
+instead of wall timing, the SAME three programs are compiled-not-run
+and decomposed analytically via :mod:`paddle_tpu.obs.hlo_cost` —
+flops / bytes / HLO op mix per variant, the optimizer and backward
+deltas, and a roofline step-time projection per chip spec.  That makes
+the tool importable and smoke-testable in tier-1 (tests/test_train_obs)
+instead of hardware-only dead code, and the cost code is the exact
+code the training observatory's :class:`CostLedger` runs.
+
+Usage:
+  PYTHONPATH=/root/.axon_site:/root/repo python tools/step_ablation.py
+  JAX_PLATFORMS=cpu python tools/step_ablation.py --offline [--full]
 """
 from __future__ import annotations
 
@@ -45,40 +58,12 @@ def _sync(out):
 
 
 def model_ablation():
-    import paddle_tpu as paddle
-    import bench
-
-    make_step, cfg, seq, model = bench.build_bench()
-    batch = 8
-    amp_level = os.environ.get("PADDLE_TPU_BENCH_AMP", "O2")
     results = {}
-
-    def record(name, seconds):
+    programs, x, y, _model, _cfg, _seq, _batch = build_ablation_programs()
+    for name, fn in programs:
+        seconds = time_calls(fn, x, y)
         results[name] = seconds
         print(f"{name}: {seconds*1e3:.2f} ms", flush=True)
-
-    train_step, x, y = make_step(batch)
-    record("full", time_calls(train_step, x, y))
-
-    @paddle.jit.to_static
-    def fwd_bwd(x, y):
-        with paddle.amp.auto_cast(dtype="bfloat16", level=amp_level):
-            loss = model.compute_loss(x, y)
-        loss.backward()
-        # discard grads like the full step's clear_grad, so repeated calls
-        # don't pay a grad-accumulate the full step doesn't have
-        model.clear_gradients()
-        return loss
-
-    record("fwd_bwd", time_calls(fwd_bwd, x, y))
-
-    @paddle.jit.to_static
-    def fwd(x, y):
-        with paddle.amp.auto_cast(dtype="bfloat16", level=amp_level):
-            loss = model.compute_loss(x, y)
-        return loss
-
-    record("fwd", time_calls(fwd, x, y))
     return results
 
 
@@ -143,7 +128,128 @@ def flash_standalone():
             "flash_fwdbwd_layer": slope(run_bwd)}
 
 
-def main():
+def build_ablation_programs(smoke: bool = False, batch: int = None):
+    """The three ablation variants as ``(name, static_fn)`` pairs plus
+    the shared example inputs — ``(programs, x, y, model, cfg, seq,
+    batch)`` — used by both the hardware timing path and the offline
+    cost path so the two decompositions can never diverge in WHAT they
+    measure, only in HOW (wall clock vs XLA cost analysis)."""
+    import paddle_tpu as paddle
+    import bench
+
+    make_step, cfg, seq, model = bench.build_bench(smoke=smoke)
+    if batch is None:
+        batch = 2 if smoke else 8
+    amp_level = os.environ.get("PADDLE_TPU_BENCH_AMP", "O2")
+
+    train_step, x, y = make_step(batch)
+
+    @paddle.jit.to_static
+    def fwd_bwd(x, y):
+        from paddle_tpu.distributed.fault_tolerance import global_grad_norm
+
+        with paddle.amp.auto_cast(dtype="bfloat16", level=amp_level):
+            loss = model.compute_loss(x, y)
+        loss.backward()
+        # the grad norm CONSUMES every gradient as a program output:
+        # without it, clearing the grads makes the whole backward dead
+        # code — XLA DCEs it and both the wall timing and the cost
+        # analysis silently measure forward-only (caught by the offline
+        # cost path: fwd_bwd flops == fwd flops)
+        gnorm = global_grad_norm(model.parameters())
+        # ...then discard, so repeated timing calls don't pay a
+        # grad-accumulate the full step doesn't have
+        model.clear_gradients()
+        return loss, gnorm
+
+    @paddle.jit.to_static
+    def fwd(x, y):
+        with paddle.amp.auto_cast(dtype="bfloat16", level=amp_level):
+            loss = model.compute_loss(x, y)
+        return loss
+
+    programs = [("full", train_step), ("fwd_bwd", fwd_bwd), ("fwd", fwd)]
+    return programs, x, y, model, cfg, seq, batch
+
+
+def offline_ablation(smoke: bool = True, batch: int = None,
+                     chip: str = None) -> dict:
+    """CPU proxy for the hardware ablation: compile-not-run each
+    variant (eval_shape state discovery + one XLA lower/compile) and
+    decompose the step by XLA cost analysis instead of wall timing.
+
+    Returns ``{"mode": "offline", "chip", "variants": {name:
+    {flops, bytes_accessed, roofline_step_ms, analytic_mfu, dot,
+    fusion, fingerprint}}, "deltas": {opt_*, bwd_*}}`` — the
+    flop/byte-level answer to "where does the step go" that needs no
+    TPU, and the regression surface the overlap work (ROADMAP item 3)
+    will move."""
+    import numpy as np
+    from paddle_tpu.obs.hlo_cost import CostLedger
+
+    programs, x, y, model, cfg, seq, batch = build_ablation_programs(
+        smoke=smoke, batch=batch)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    ledger = CostLedger(chip=chip)
+    out = {"mode": "offline", "chip": ledger.chip,
+           "config": {"smoke": smoke, "batch": batch, "seq": seq,
+                      "n_params": n_params},
+           "variants": {}}
+    for name, fn in programs:
+        rec = ledger.add(name, fn, x, y,
+                         tokens_per_step=batch * seq, n_params=n_params)
+        out["variants"][name] = {
+            "flops": rec["flops"],
+            "bytes_accessed": rec["bytes_accessed"],
+            "transcendentals": rec["transcendentals"],
+            "dot": rec["hlo_counts"]["dot"],
+            "fusion": rec["hlo_counts"]["fusion"],
+            "roofline_step_ms": rec["roofline_step_ms"],
+            "analytic_mfu": rec["analytic_mfu"],
+            "bound": rec["bound"],
+            "flops_vs_6nd": rec["flops_vs_6nd"],
+            "fingerprint": rec["fingerprint"],
+        }
+    v = out["variants"]
+    out["deltas"] = {
+        # what the optimizer adds on top of fwd+bwd, and backward on
+        # top of forward — the same subtractions the hardware path does
+        # on wall time, here on flops/bytes/projected roofline time
+        "opt_flops": v["full"]["flops"] - v["fwd_bwd"]["flops"],
+        "opt_bytes": v["full"]["bytes_accessed"]
+        - v["fwd_bwd"]["bytes_accessed"],
+        "opt_roofline_ms": round(v["full"]["roofline_step_ms"]
+                                 - v["fwd_bwd"]["roofline_step_ms"], 6),
+        "bwd_flops": v["fwd_bwd"]["flops"] - v["fwd"]["flops"],
+        "bwd_bytes": v["fwd_bwd"]["bytes_accessed"]
+        - v["fwd"]["bytes_accessed"],
+        "bwd_roofline_ms": round(v["fwd_bwd"]["roofline_step_ms"]
+                                 - v["fwd"]["roofline_step_ms"], 6),
+    }
+    out["fingerprint"] = ledger.fingerprint()
+    return out
+
+
+def main(argv=None):
+    args = list(sys.argv[1:] if argv is None else argv)
+    offline = "--offline" in args
+    full = "--full" in args
+    for known in ("--offline", "--full"):
+        while known in args:
+            args.remove(known)
+    if args:
+        print(f"step_ablation: unknown argument(s) {args}", file=sys.stderr)
+        return 2
+    # no TPU to time against ⇒ the offline cost decomposition is the
+    # only honest answer (wall-timing XLA:CPU says nothing about MXU)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        offline = True
+    if offline:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(offline_ablation(smoke=not full), indent=1))
+        return 0
     res = model_ablation()
     res.update(flash_standalone())
     res_ms = {k: round(v * 1e3, 2) for k, v in res.items()}
@@ -151,7 +257,8 @@ def main():
     res_ms["bwd_ms"] = round((res["fwd_bwd"] - res["fwd"]) * 1e3, 2)
     res_ms["attn_total_ms"] = round(res["flash_fwdbwd_layer"] * 24 * 1e3, 2)
     print(json.dumps(res_ms, indent=1))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
