@@ -404,6 +404,152 @@ def _paged_kernel_microbench(model):
     }
 
 
+def _durability_drill(model):
+    """Crash-recovery drill (ISSUE 14): an engine journals live traffic
+    into a :class:`RequestJournal` and is ABANDONED mid-decode (the
+    in-process stand-in for the SIGKILL drill tests/test_durability.py
+    runs as a real subprocess); a fresh engine re-scans the journal,
+    ``recover()``-s every non-terminal request, and must finish them
+    all — terminal exactly once (``duplicate_terminals == 0``), zero
+    steady-state compile misses, nothing lost.  Emits the measured
+    ``serving_recovery_ms`` (recover + replay-to-completion wall time)
+    and ``serving_journal_replayed``."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+    from paddle_tpu.serving import Engine, RequestJournal
+
+    FAIL_METRIC = "serving_gpt_tiny_decode_tokens_per_sec"
+    with tempfile.TemporaryDirectory() as td:
+        jdir = os.path.join(td, "journal")
+        eng = Engine(model, num_slots=4, max_seq=64, min_bucket=8,
+                     journal=RequestJournal(jdir))
+        eng.warmup()
+        rs = np.random.RandomState(123)
+        prompts = [rs.randint(0, 128, (L,)).tolist()
+                   for L in (5, 12, 9, 17, 7, 21)]
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=10)
+        for _ in range(4):
+            eng.step()                   # tokens streamed, then "crash"
+
+        j2 = RequestJournal(jdir)        # fresh-process view: re-scan
+        if not j2.pending():
+            fail_structured("durability drill: nothing was in flight "
+                            "at the crash point", metric=FAIL_METRIC)
+        eng2 = Engine(model, num_slots=4, max_seq=64, min_bucket=8,
+                      journal=j2)
+        eng2.warmup()
+        misses0 = eng2.metrics.compile_misses
+        t0 = _time.perf_counter()
+        info = eng2.recover()
+        eng2.run()
+        recovery_ms = (_time.perf_counter() - t0) * 1e3
+        audit = j2.audit()
+        if audit["pending"] or audit["duplicate_terminals"] or \
+                any(r.state != "finished" for r in info["requests"]):
+            fail_structured(
+                f"durability drill lost a request: {audit}, states="
+                f"{[r.state for r in info['requests']]}",
+                metric=FAIL_METRIC)
+        if eng2.metrics.compile_misses != misses0:
+            fail_structured(
+                "crash recovery added steady-state compile misses",
+                metric=FAIL_METRIC)
+        # close (and unregister) both journal handles: the tempdir dies
+        # with this with-block, and a stale registration would hijack
+        # crash_dir() for the rest of the bench process
+        eng.journal.close()
+        j2.close()
+        return {
+            "serving_recovery_ms": round(recovery_ms, 3),
+            "serving_journal_replayed": info["replayed"],
+        }
+
+
+def _hot_swap_drill(model):
+    """Rolling weight hot-swap drill (ISSUE 14): a 2-replica paged
+    fleet serves live streams while ``Fleet.update_weights`` drains and
+    swaps one replica at a time (weight isolation: the other replica
+    keeps answering on the old weights).  Fails structured unless every
+    request — in-flight across the roll AND submitted after — finishes,
+    no replica adds an executable-cache key, and no post-roll admission
+    prefix-hits a block prefilled under the old weights (the version
+    epoch).  Emits ``serving_hot_swap_stall_ms``: the worst per-request
+    inter-token gap observed across the roll — the number a
+    zero-downtime claim lives or dies on."""
+    import time as _time
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import Fleet
+
+    FAIL_METRIC = "serving_gpt_tiny_decode_tokens_per_sec"
+    paddle.seed(31)
+    new_sd = GPTForCausalLM(gpt_tiny()).state_dict()
+    fleet = Fleet(model, num_replicas=2, num_slots=2, max_seq=64,
+                  min_bucket=8, kv_layout="paged", block_size=8)
+    fleet.warmup()
+    if not fleet.weights_isolated:
+        fail_structured("hot-swap drill: fleet fell back to shared "
+                        "weights", metric=FAIL_METRIC)
+    gaps, last = {}, {}
+
+    def cb(tok, fr):
+        now = _time.perf_counter()
+        if fr.request_id in last:
+            gaps[fr.request_id] = max(gaps.get(fr.request_id, 0.0),
+                                      now - last[fr.request_id])
+        last[fr.request_id] = now
+
+    rs = np.random.RandomState(99)
+    prompts = [rs.randint(0, 128, (L,)).tolist() for L in (6, 13, 9, 18)]
+    live = [fleet.submit(p, max_new_tokens=16, stream_cb=cb)
+            for p in prompts]
+    for _ in range(2):
+        fleet.step()                     # streams flowing on both replicas
+    misses = {rep.engine.name: rep.engine.metrics.compile_misses
+              for rep in fleet.replicas}
+    roll = fleet.update_weights(new_sd, max_drain_steps=2000)
+    hits_at_roll = sum(rep.engine.prefix_cache.hit_tokens_total
+                       for rep in fleet.replicas)
+    post = [fleet.submit(p, max_new_tokens=8, stream_cb=cb)
+            for p in prompts[:2]]        # the SAME prompts, post-swap
+    fleet.run()
+    st = fleet.stats()
+    if any(r.state != "finished" for r in live + post) or \
+            st["requests"]["failed"] or \
+            st["requests"]["duplicate_terminals"]:
+        fail_structured(
+            f"hot swap dropped traffic: {st['requests']}, states="
+            f"{[r.state for r in live + post]}", metric=FAIL_METRIC)
+    for rep in fleet.replicas:
+        if rep.engine.metrics.compile_misses != misses[rep.engine.name]:
+            fail_structured(
+                f"hot swap added compile keys on {rep.engine.name}",
+                metric=FAIL_METRIC)
+    hits_after = sum(rep.engine.prefix_cache.hit_tokens_total
+                     for rep in fleet.replicas)
+    if hits_after != hits_at_roll:
+        fail_structured(
+            "post-roll admission prefix-hit blocks prefilled under the "
+            "old weights (version epoch breached)", metric=FAIL_METRIC)
+    if any(r.model_version != 0 for r in live) or \
+            any(r.model_version != 1 for r in post):
+        fail_structured(
+            "model-version tagging wrong across the roll",
+            metric=FAIL_METRIC)
+    fleet.shutdown(timeout_s=0.0)
+    return {
+        "serving_hot_swap_stall_ms":
+            round(max(gaps.values()) * 1e3, 3) if gaps else 0.0,
+        "serving_hot_swap_roll_ms": roll["roll_ms"],
+        "serving_hot_swap_model_version": roll["model_version"],
+    }
+
+
 def serving_main():
     """Serving smoke bench: continuous-batching decode throughput + TTFT
     on the tiny GPT config (ISSUE 3).  Same one-JSON-line contract as the
@@ -541,6 +687,10 @@ def serving_main():
     # -- overload trace-replay: priorities vs the no-priority baseline ---
     trace = _trace_replay(model)
 
+    # -- durability: crash recovery + rolling weight hot-swap ------------
+    durability = _durability_drill(model)
+    hot_swap = _hot_swap_drill(model)
+
     def _p50_ttft_ms(reqs):
         ts = sorted(r.ttft_s for r in reqs)
         return round(ts[len(ts) // 2] * 1e3, 3)
@@ -603,6 +753,15 @@ def serving_main():
         # priority p99 TTFT with priority scheduling vs the no-priority
         # baseline on the identical trace (enforced <)
         **trace,
+        # durability drills (ISSUE 14): journaled crash recovery
+        # (recover + replay-to-completion wall time, requests replayed;
+        # fails structured on any lost request or steady-state compile)
+        # and the rolling hot-swap under live traffic (worst observed
+        # per-request inter-token gap across the roll; fails structured
+        # on any failed request, new compile key, or stale prefix hit
+        # across the version epoch)
+        **durability,
+        **hot_swap,
     }))
 
 

@@ -229,6 +229,19 @@ class ResilientLoop:
                   f"{self.sentry.rollbacks} rollback(s); flight dump "
                   f"frozen ({len(dump['events'])} steps)")
         self.timeline.on_escalate(step)
+        # escalation usually ends the process (the caller fail-stops):
+        # persist the frozen dump + any armed trace NOW, while we still
+        # can — best effort, the raise below happens regardless
+        try:
+            from ...obs.crashdump import persist_crash_artifacts
+
+            p = persist_crash_artifacts(
+                f"sentry escalation at step {step}",
+                extra={"sentry": self.sentry_stats()})
+            if p is not None:
+                self._log(f"crash artifacts persisted to {p}")
+        except Exception:                # noqa: BLE001 — best effort
+            pass
         raise SentryEscalation(
             f"divergence sentry escalated at step {step} "
             f"(anomaly {report.flags() or report.code}; "

@@ -133,6 +133,21 @@ class StepWatchdog:
             if self.on_timeout is not None:
                 self.on_timeout()
             if self.hard_exit:
+                # the post-mortem must outlive the process os._exit is
+                # about to kill: persist every flight ring and armed
+                # trace to $PADDLE_TPU_TRACE_DIR (or the journal's
+                # crash/ sibling) — best-effort, never blocks the exit
+                try:
+                    from ...obs.crashdump import persist_crash_artifacts
+
+                    p = persist_crash_artifacts(
+                        f"watchdog: no step boundary for "
+                        f"{stalled:.1f}s (deadline {deadline:.1f}s)")
+                    if p:
+                        print(f"[watchdog] crash artifacts persisted "
+                              f"to {p}", file=sys.stderr)
+                except Exception:        # noqa: BLE001 — exiting anyway
+                    pass
                 sys.stderr.flush()
                 sys.stdout.flush()
                 os._exit(self.exit_code)

@@ -61,19 +61,28 @@ class FlightRecorder:
 
     def dump(self, reason: str) -> dict:
         """Freeze the ring into a post-mortem record (newest events
-        last).  Safe to call from the watchdog thread: the scheduler is
-        stalled when the watchdog fires, so the ring is quiescent; a
-        racing append at worst drops this dump's tail."""
+        last) and bank it on ``dumps``.  Safe to call from the watchdog
+        thread: the scheduler is stalled when the watchdog fires, so
+        the ring is quiescent; a racing append at worst drops this
+        dump's tail."""
+        d = self.peek(reason)
+        self.dumps.append(d)
+        del self.dumps[:-self.max_dumps]
+        return d
+
+    def peek(self, reason: str) -> dict:
+        """A dump-shaped view of the CURRENT ring WITHOUT banking it —
+        the crash-dump path reads every live recorder this way so
+        persisting artifacts never perturbs recorder state (a banked
+        dump is an event consumers assert on; a crash capture must not
+        manufacture one)."""
         try:
             events = [dict(e) for e in self._ring]
         except RuntimeError:             # ring mutated mid-copy
             events = []
-        d = {"name": self.name, "reason": reason,
-             "wall_time": time.time(), "steps_seen": self.steps_seen,
-             "events": events}
-        self.dumps.append(d)
-        del self.dumps[:-self.max_dumps]
-        return d
+        return {"name": self.name, "reason": reason,
+                "wall_time": time.time(), "steps_seen": self.steps_seen,
+                "events": events}
 
     def snapshot(self) -> dict:
         """JSON-ready view: ring occupancy plus every retained dump."""

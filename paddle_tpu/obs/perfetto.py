@@ -149,6 +149,33 @@ def chrome_trace(tracer) -> dict:
             target = tracer.spans.get(ev.get("resume_span"))
         elif kind == "redispatch":
             target = tracer.spans.get(ev.get("attempt_span"))
+        elif kind == "recovered" and ev.get("origin_wall") is not None:
+            # cross-PROCESS resume: the pre-crash attempt's events died
+            # with its process, so the link is WALL-anchored — a
+            # synthetic instant at the journaled original admission's
+            # wall time (mapped through this tracer's one-shot anchor,
+            # usually negative: before this tracer started) flows into
+            # the recovery attempt span
+            target = tracer.spans.get(ev.get("span"))
+            if target is not None:
+                origin_ts = _us(ev["origin_wall"] - tracer.wall0)
+                flow_id += 1
+                out.append({"ph": "i", "s": "t", "pid": pid, "tid": tid,
+                            "ts": origin_ts, "name": "pre_crash_admission",
+                            "cat": "recovered",
+                            "args": {"journal_id": ev.get("journal_id"),
+                                     "origin_wall": ev["origin_wall"]}})
+                out.append({"ph": "s", "id": flow_id, "pid": pid,
+                            "tid": tid, "ts": origin_ts,
+                            "name": kind, "cat": "link"})
+                out.append({"ph": "f", "bp": "e", "id": flow_id,
+                            "pid": tracks.pid(target["replica"]),
+                            "tid": tracks.tid(target["replica"],
+                                              target.get("slot"),
+                                              target.get("thread")),
+                            "ts": _us(target["t_start"]), "name": kind,
+                            "cat": "link"})
+            target = None                # arrows already emitted
         if target is not None:
             flow_id += 1
             out.append({"ph": "s", "id": flow_id, "pid": pid, "tid": tid,

@@ -38,6 +38,18 @@ an engine turns unhealthy or is ejected, and ``paddle_tpu.obs`` exports
 Perfetto/Chrome trace JSON, JSONL event logs, and a Prometheus-style
 text exposition — see docs/SERVING.md "Tracing & flight recorder".
 
+The deployment also degrades per-process, never per-deployment: an
+append-only CRC-per-record :class:`RequestJournal` makes every accepted
+request durable (segment rotation, terminal-prefix compaction,
+configurable fsync), ``Engine.recover`` / ``Fleet.recover`` rehydrate
+non-terminal work after a crash — stream restart from token 0,
+``recovered``-marked, bitwise-identical greedy/seeded replays via the
+journaled effective seed, terminals exactly once across the crash —
+and ``Fleet.update_weights`` rolls new weights through a live fleet
+one drained replica at a time (in-place buffer write-through: zero new
+compile keys; prefix-cache version epoch: zero stale-weight KV hits) —
+see docs/SERVING.md "Durability & hot swap".
+
 One level up, the fleet degrades per-replica, never per-fleet:
 :class:`Fleet` supervises N engine replicas behind one
 submit/stream/cancel surface — prefix-affinity dispatch, health-driven
@@ -61,6 +73,7 @@ from .tracing import (  # noqa: F401
     validate_trace,
 )
 from .metrics import ServingMetrics, FleetMetrics  # noqa: F401
+from .journal import RequestJournal, JournalCorrupt  # noqa: F401
 from .engine import (  # noqa: F401
     Engine, Request, QueueFull, ShedReject, EngineStopped,
     PRIORITY_LOW, PRIORITY_NORMAL, PRIORITY_HIGH,
@@ -76,4 +89,5 @@ __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "PrefixCache", "AllocatorError",
            "Fleet", "FleetRequest", "FleetMetrics", "SyncSanitizer",
            "RequestTracer", "NullTracer", "NULL_TRACER",
-           "FlightRecorder", "validate_trace"]
+           "FlightRecorder", "validate_trace",
+           "RequestJournal", "JournalCorrupt"]

@@ -68,6 +68,7 @@ the existing prefill buckets and add ZERO executable-cache keys
 from __future__ import annotations
 
 import itertools
+import os
 import time
 import weakref
 from collections import deque
@@ -113,6 +114,41 @@ def _as_priority(priority) -> int:
                 f"unknown priority {priority!r}; want one of "
                 f"{sorted(_PRIORITY_NAMES)} or an int") from None
     return int(priority)
+
+
+def _resolve_weights(state_or_path):
+    """Normalize ``update_weights`` input to a flat state dict: a dict
+    passes through, a ``.npz`` path loads its arrays, a directory loads
+    a ``distributed.checkpoint.save_state_dict`` checkpoint (the
+    fault-tolerant training stack's output format)."""
+    if isinstance(state_or_path, dict):
+        return state_or_path
+    if isinstance(state_or_path, (str, os.PathLike)):
+        p = os.fspath(state_or_path)
+        if os.path.isdir(p):
+            from ..distributed.checkpoint import load_state_dict
+
+            return load_state_dict(p)
+        if p.endswith(".npz"):
+            with np.load(p) as z:
+                return {k: z[k] for k in z.files}
+        raise ValueError(
+            f"update_weights: {p!r} is neither a checkpoint directory "
+            "nor an .npz file")
+    raise TypeError(
+        "update_weights wants a state dict, a checkpoint directory, or "
+        f"an .npz path, got {type(state_or_path).__name__}")
+
+
+def _write_state_dict(model, sd, what: str = "update_weights") -> None:
+    """Write ``sd`` through ``model``'s existing buffers and insist on
+    full coverage — the one shared coverage check for every weight-swap
+    write site (a partial write would serve a frankenmodel)."""
+    missing, unexpected = model.set_state_dict(sd)
+    if missing or unexpected:
+        raise ValueError(
+            f"{what}: state dict does not cover the model "
+            f"(missing={missing[:5]}, unexpected={unexpected[:5]})")
 
 
 class QueueFull(RuntimeError):
@@ -174,6 +210,17 @@ class Request:
     #: resume (``preemptions`` counts the evictions)
     preempted: bool = False
     preemptions: int = 0
+    #: durable identity in the request journal (``Engine(journal=...)``);
+    #: stable across preemption, redispatch, AND process crashes — the
+    #: exactly-once terminal audit keys on it
+    journal_id: Optional[str] = None
+    #: set when this admission is a crash-recovery replay rehydrated
+    #: from the journal: the stream restarted from token 0 (the
+    #: redispatch contract, one process-death further out)
+    recovered: bool = False
+    #: weight version the serving engine held when this request was
+    #: admitted (bumped by rolling hot-swaps; 0 = initial weights)
+    model_version: int = 0
     error: Optional[str] = None
     #: machine-readable context for backpressure/shed rejections
     #: (``{"depth": int, "retry_after_s": float}``)
@@ -301,6 +348,15 @@ class Engine:
             :class:`~.tracing.FlightRecorder` (the last N step
             summaries, dumped automatically when ``health()`` flips
             unhealthy or the fleet ejects this replica).
+        journal: a :class:`~.journal.RequestJournal` — every accepted
+            request is journaled durably (admission with the full
+            replay recipe, batched per-step token records, terminal
+            record) so a fresh process can ``recover()`` it after a
+            crash.  Default None: no journaling, no overhead.  Share
+            ONE journal across a fleet (fleet-managed there).
+        model_version: initial weight version tag (bumped in place by
+            ``update_weights``; each request records the version that
+            served it).
     """
 
     def __init__(self, model, *, num_slots: int = 4,
@@ -323,7 +379,9 @@ class Engine:
                  max_preemptions: int = 2,
                  priority_aging_s: Optional[float] = 5.0,
                  tracer=None,
-                 flight_recorder_steps: int = 256):
+                 flight_recorder_steps: int = 256,
+                 journal=None,
+                 model_version: int = 0):
         cfg = getattr(model, "config", None)
         if cfg is None:
             raise TypeError("Engine needs a model carrying a .config "
@@ -450,6 +508,15 @@ class Engine:
         self.tracer = tracer
         self.flight = FlightRecorder(flight_recorder_steps,
                                      name=self.name)
+        # durable request journal (docs/SERVING.md "Durability & hot
+        # swap"): a RequestJournal WAL of admission/token/terminal
+        # records — None (default) journals nothing and costs nothing.
+        # All journal writes are host-side file I/O outside the
+        # hot-path dispatch functions.
+        self.journal = journal
+        #: weight version this engine serves (bumped by update_weights;
+        #: every admission tags its request with the current value)
+        self.model_version = int(model_version)
         self.state = "active"    # active | draining | stopped | unhealthy
         self._unhealthy_reason: Optional[str] = None
         self._consecutive_failures = 0
@@ -745,13 +812,29 @@ class Engine:
                       priority=prio,
                       request_id=next(self._req_counter))
         req.t_enqueue = time.perf_counter()
+        origin_wall = None
+        jr = self.journal
+        if jr is not None:
+            # durable identity, consumed BEFORE the admission-control
+            # checks: the router/recovery may have armed an adoption
+            # (fleet-scoped id, recovered flag), and a recovered replay
+            # must be exempt from SLO shedding below — it was accepted
+            # once already, before the crash.  Otherwise the id is
+            # engine-scoped, uniquified across process restarts by the
+            # journal's boot marker.
+            pend = jr.take_pending()
+            if pend is not None:
+                req.journal_id, req.recovered, origin_wall = pend
+            else:
+                req.journal_id = \
+                    f"{self.name}:b{jr.boot}:r{req.request_id}"
         problem = self._validate(req)
         if problem is not None:
             self._reject(req, problem)
             err = ValueError(problem)
             err.request = req
             raise err
-        wait = self._shed_wait_s(req)
+        wait = None if req.recovered else self._shed_wait_s(req)
         if wait is not None:
             depth = len(self.queue)
             msg = (f"shed: estimated queue wait {wait:.3f}s exceeds "
@@ -786,9 +869,41 @@ class Engine:
                 err.request = req
                 raise err
         req._engine = weakref.ref(self)
+        if jr is not None:
+            # WAL discipline: the admission record commits BEFORE the
+            # request enters the queue.  A failing journal write (disk
+            # full, closed file) must not leave the engine serving a
+            # request its caller was told failed — reject the handle
+            # and surface the storage error instead.
+            s = req.sampling
+            try:
+                jr.record_admission(
+                    req.journal_id, prompt_ids=req.prompt_ids,
+                    sampling={"temperature": s.temperature,
+                              "top_k": s.top_k,
+                              "top_p": s.top_p, "seed": s.seed},
+                    seed_effective=self._seed_for(req),
+                    priority=req.priority, deadline_s=req.deadline_s,
+                    max_new_tokens=req.max_new_tokens,
+                    eos_token_id=req.eos_token_id, engine=self.name,
+                    model_version=self.model_version,
+                    recovered=req.recovered)
+            except Exception as e:       # noqa: BLE001 — storage failure
+                req.journal_id = None    # nothing durable to audit
+                self._reject(req, f"journal admission write failed: "
+                                  f"{type(e).__name__}: {e}")
+                try:
+                    e.request = req      # the rejection-path convention
+                except Exception:        # noqa: BLE001 — exotic exc type
+                    pass
+                raise
         self.queue.append(req)
         self.metrics.on_enqueue(len(self.queue))
         self.tracer.on_queued(req, self.name)
+        if jr is not None and req.recovered:
+            self.metrics.on_recovered()
+            self.tracer.on_recovered(req, self.name, origin_wall,
+                                     journal_id=req.journal_id)
         return req
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
@@ -999,6 +1114,10 @@ class Engine:
         self.queue.append(victim)        # aging runs from its original
         self.metrics.on_preempt(len(self.queue))     # t_enqueue
         self.tracer.on_preempt(victim, self.name)
+        if self.journal is not None and victim.journal_id is not None:
+            # the journaled stream restarts too: tokens before this
+            # record are superseded by the resume's replay from token 0
+            self.journal.record_restart(victim.journal_id, "preempt")
 
     def _on_cancel(self, req: Request) -> None:
         """Queued requests leave immediately; running ones are retired at
@@ -1191,6 +1310,7 @@ class Engine:
         now = time.perf_counter()
         self.metrics.prefill_time_s += now - t0
         req.state, req.prefill_bucket = "running", bucket
+        req.model_version = self.model_version
         req._seq_len = L
         self.running[req.slot] = req
         self.metrics.on_admit(bucket, L, len(self.queue))
@@ -1205,6 +1325,11 @@ class Engine:
         per-admission (never per-decode-step) pull, outside the
         hot-path dispatch functions."""
         tok = int(tok_t.numpy())
+        if self.journal is not None and req.journal_id is not None:
+            # journal BEFORE the user-visible emit: delivery is
+            # at-least-once across a crash by contract
+            self.journal.record_tokens(self.name, self._step_counter,
+                                       {req.journal_id: tok})
         if not self._emit_token(req, tok, now):
             return
         self.metrics.on_first_token(req.ttft_s)
@@ -1261,6 +1386,15 @@ class Engine:
         elif state == "failed":
             self.metrics.on_fail()
         self.tracer.on_retired(req, self.name, state, req.error)
+        if self.journal is not None and req.journal_id is not None:
+            # fleet-owned requests end their ATTEMPT here; the router's
+            # exactly-once _finish writes the one FINAL end (mirror of
+            # the tracer's final-event ownership)
+            self.journal.record_end(
+                req.journal_id, state,
+                final=not self.journal.is_fleet_owned(req.journal_id),
+                error=req.error, n_tokens=len(req.output_ids),
+                engine=self.name)
 
     def _mark_block_corruption(self, reason: str) -> None:
         """A block-accounting violation is engine-fatal for trust (not
@@ -1361,6 +1495,16 @@ class Engine:
         then run callbacks and retirement checks."""
         toks = out.numpy()                       # [slots] int32
         now = time.perf_counter()
+        if self.journal is not None:
+            # ONE batched record per engine step covering every active
+            # slot (never one record per token) — the same batching
+            # discipline as the tracer's decode_step event
+            tokmap = {r.journal_id: int(toks[s])
+                      for s, r in self.running.items()
+                      if r.journal_id is not None}
+            if tokmap:
+                self.journal.record_tokens(self.name, self._step_counter,
+                                           tokmap)
         self.metrics.on_decode_step(len(self.running), now - t0)
         tr = self.tracer
         if tr.enabled:
@@ -1570,6 +1714,122 @@ class Engine:
         except Exception:                # noqa: BLE001 — advisory only
             return 0
 
+    # -- durability: crash recovery & weight hot-swap ----------------------
+
+    def recover(self, journal=None) -> dict:
+        """Crash-consistent recovery: rehydrate every non-terminal
+        journaled request (admission recorded, no final end) and
+        re-enqueue it as a replay-from-prompt under the stream-restart
+        contract — ``recovered`` flag set, stream restarting at token
+        0, the slot's device key lane re-seeded from the JOURNALED
+        effective seed so greedy and seeded outputs are bitwise
+        identical to an uninterrupted run.  Pre-crash terminal
+        outcomes are banked into the metrics so the counters stay
+        monotone across the restart.
+
+        Call on a fresh engine AFTER ``warmup()`` and before any
+        traffic.  ``journal`` defaults to the engine's own; passing one
+        here also attaches it.  Returns
+        ``{"replayed", "requests", "outcomes"}``."""
+        journal = journal if journal is not None else self.journal
+        if journal is None:
+            raise ValueError("recover() needs a RequestJournal (pass "
+                             "journal= here or to the Engine)")
+        if self.running or self.queue:
+            raise RuntimeError("recover() must run before serving "
+                               "traffic (the journal's replay order is "
+                               "the recovered queue order)")
+        if self.journal is not None and journal is not self.journal:
+            raise ValueError(
+                "recover(journal=...) does not match the journal this "
+                "engine records into — replaying one journal while "
+                "recording into another leaves the replayed journal's "
+                "pending set non-converging")
+        self.journal = journal
+        outcomes = journal.outcomes()
+        self.metrics.bank_outcomes(outcomes)
+        replayed, invalid = [], []
+        saved_max_queue, self.max_queue = self.max_queue, None
+        try:
+            for jid, rec in journal.pending().items():
+                s = journal.replay_sampling(rec)
+                journal.begin_attempt(jid, recovered=True,
+                                      origin_wall=rec.get("wall"))
+                try:
+                    r = self.add_request(
+                        rec["prompt_ids"],
+                        max_new_tokens=rec["max_new_tokens"],
+                        sampling=SamplingParams(**s),
+                        eos_token_id=rec["eos_token_id"],
+                        deadline_s=rec["deadline_s"],
+                        priority=rec["priority"])
+                except ValueError as e:
+                    # a replay this engine cannot validate (e.g. the
+                    # restart shrank max_seq): fail THAT request with a
+                    # final end so the journal converges instead of
+                    # wedging every future recover() on the same jid —
+                    # and keep replaying the rest
+                    journal.record_end(jid, "failed", final=True,
+                                       error=f"recovery replay "
+                                             f"rejected: {e}",
+                                       engine=self.name)
+                    invalid.append(getattr(e, "request", None) or jid)
+                    continue
+                finally:
+                    journal.end_attempt()
+                replayed.append(r)
+        finally:
+            self.max_queue = saved_max_queue
+        return {"replayed": len(replayed), "requests": replayed,
+                "invalid": invalid, "outcomes": outcomes}
+
+    def update_weights(self, state_or_path, *,
+                       version: Optional[int] = None) -> int:
+        """Hot-swap the model weights IN PLACE on an idle engine.
+
+        The write goes *through* the existing parameter buffers
+        (``set_state_dict`` ``_set_data`` write-through), so every
+        warmed executable and its lifted state stay valid — zero new
+        compile keys, pinned by the shape manifest.  The prefix-cache
+        **version epoch** is bumped so no later request can prefix-hit
+        KV blocks prefilled under the old weights, and
+        ``model_version`` advances so every admission records which
+        weights served it.
+
+        The engine must be idle (no queued or running work): an
+        in-flight request's KV was computed under the old weights and
+        decoding it under new ones would serve a torn hybrid.  The
+        fleet's rolling ``update_weights`` guarantees that by draining
+        one replica at a time.  Accepts a state dict, an ``.npz`` path,
+        or a ``distributed.checkpoint.save_state_dict`` directory.
+        Returns the new version."""
+        if self.running or self.queue:
+            raise RuntimeError(
+                f"engine {self.name!r} has in-flight work "
+                f"({len(self.running)} running, {len(self.queue)} "
+                "queued): drain before update_weights — decoding KV "
+                "prefilled under old weights with new weights would "
+                "serve a torn response")
+        sd = _resolve_weights(state_or_path)
+        _write_state_dict(self.model, sd)
+        return self._mark_weights_swapped(version)
+
+    def _mark_weights_swapped(self, version: Optional[int] = None) -> int:
+        """The per-engine half of a weight swap — prefix-epoch bump,
+        version tag, metrics/tracer/journal — split out so a fleet
+        whose replicas SHARE one parameter set (the stop-the-world
+        fallback) can write the state dict once and still give every
+        engine its own epoch/version bookkeeping."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.bump_epoch()
+        self.model_version = (int(version) if version is not None
+                              else self.model_version + 1)
+        self.metrics.on_weight_swap(self.model_version)
+        self.tracer.on_weight_swap(self.name, self.model_version)
+        if self.journal is not None:
+            self.journal.record_weight_swap(self.name, self.model_version)
+        return self.model_version
+
     def _stop_watchdog(self) -> None:
         """Join and drop the watchdog thread so a drained/stopped engine
         holds no thread alive (its bound-method callback would otherwise
@@ -1649,6 +1909,8 @@ class Engine:
         self.metrics._slots_busy = len(self.running)
         self.metrics.queue_depth = len(self.queue)
         snap = self.metrics.snapshot()
+        if self.journal is not None:
+            snap["durability"]["journal"] = self.journal.stats()
         if self.sanitizer is not None:
             snap["sanitizer"] = self.sanitizer.report()
         if self.tracer.enabled:

@@ -69,6 +69,15 @@ class ServingMetrics:
         # rejection, this counter distinguishes the cause)
         self.requests_preempted = 0
         self.requests_shed = 0
+        # durability (ISSUE 14): pre-crash terminal outcomes banked from
+        # the request journal at recovery (folded into the live counters
+        # so completed/failed stay MONOTONE across a process restart —
+        # the same banking FleetMetrics does for ejected replicas), plus
+        # recovery/hot-swap counters
+        self.banked_outcomes: Dict[str, int] = {}
+        self.requests_recovered = 0
+        self.weight_swaps = 0
+        self.model_version = 0
         self.step_failures = 0
         self.step_retries = 0
         self.retries_by_point: Dict[str, int] = {}
@@ -151,6 +160,40 @@ class ServingMetrics:
         exceeded its deadline, so it was rejected with ``retry_after_s``
         instead of prefilled doomed."""
         self.requests_shed += 1
+
+    def bank_outcomes(self, outcomes: Dict[str, int]) -> None:
+        """Fold a recovered journal's pre-crash terminal counts into the
+        live counters (``Engine.recover``): a restarted engine's
+        ``requests_completed``/``requests_failed`` continue from where
+        the crashed process left off instead of resetting to zero.  The
+        raw banked dict stays visible in the snapshot for auditing."""
+        total = 0
+        for state, n in outcomes.items():
+            self.banked_outcomes[state] = \
+                self.banked_outcomes.get(state, 0) + int(n)
+            total += int(n)
+        # the pipeline counters move together so derived gauges
+        # (in-flight = enqueued - terminal, completion rate) stay sane:
+        # every banked outcome was enqueued — and, rejections aside,
+        # admitted — in the crashed process (the fleet-side bank adds
+        # to `submitted` for the same reason)
+        self.requests_enqueued += total
+        self.requests_admitted += total - int(outcomes.get("rejected", 0))
+        self.requests_completed += int(outcomes.get("finished", 0))
+        self.requests_failed += int(outcomes.get("failed", 0))
+        self.requests_cancelled += int(outcomes.get("cancelled", 0))
+        self.requests_rejected += int(outcomes.get("rejected", 0))
+
+    def on_recovered(self) -> None:
+        """One journaled non-terminal request was rehydrated and
+        re-enqueued by crash recovery."""
+        self.requests_recovered += 1
+
+    def on_weight_swap(self, version: int) -> None:
+        """The engine's weights were hot-swapped in place (drained,
+        written through the existing buffers, prefix epoch bumped)."""
+        self.weight_swaps += 1
+        self.model_version = int(version)
 
     def on_callback_error(self) -> None:
         self.callback_errors += 1
@@ -242,6 +285,12 @@ class ServingMetrics:
             else None,
             "overload": {"preemptions": self.requests_preempted,
                          "shed": self.requests_shed},
+            "durability": {
+                "recovered": self.requests_recovered,
+                "banked": dict(sorted(self.banked_outcomes.items())),
+                "weight_swaps": self.weight_swaps,
+                "model_version": self.model_version,
+            },
             "paging": self._paging_section(),
             "queue_depth": self.queue_depth,
             "queue_depth_max": self.queue_depth_max,
@@ -296,6 +345,14 @@ class FleetMetrics:
         self.rebuild_failures = 0
         self.last_recovery_s: Optional[float] = None
         self.total_recovery_s = 0.0
+        # durability (ISSUE 14): crash recovery + rolling weight rolls
+        self.banked_outcomes: Dict[str, int] = {}
+        self.requests_recovered = 0
+        self.crash_recoveries = 0
+        self.last_crash_recovery_s: Optional[float] = None
+        self.weight_rolls = 0
+        self.last_roll_s: Optional[float] = None
+        self.model_version = 0
         # router-provided per-replica table (occupancy, state, queue)
         self.replicas_cb = None
         # router-provided banked flight-recorder dumps, keyed by engine
@@ -347,6 +404,32 @@ class FleetMetrics:
         else:
             self.rebuild_failures += 1
 
+    def bank_outcomes(self, outcomes: Dict[str, int]) -> None:
+        """Fold a recovered journal's pre-crash FINAL terminal counts
+        into the fleet counters (``Fleet.recover``) so completed/failed
+        stay monotone across a process restart — the same scheme the
+        fleet already uses to bank an ejected replica's preemptions."""
+        total = 0
+        for state, n in outcomes.items():
+            self.banked_outcomes[state] = \
+                self.banked_outcomes.get(state, 0) + int(n)
+            total += int(n)
+        self.submitted += total
+        self.completed += int(outcomes.get("finished", 0))
+        self.failed += int(outcomes.get("failed", 0))
+        self.cancelled += int(outcomes.get("cancelled", 0))
+        self.rejected += int(outcomes.get("rejected", 0))
+
+    def on_crash_recovery(self, replayed: int, recovery_s: float) -> None:
+        self.crash_recoveries += 1
+        self.requests_recovered += int(replayed)
+        self.last_crash_recovery_s = recovery_s
+
+    def on_weight_roll(self, version: int, roll_s: float) -> None:
+        self.weight_rolls += 1
+        self.last_roll_s = roll_s
+        self.model_version = int(version)
+
     # -- export ------------------------------------------------------------
 
     def affinity_hit_rate(self) -> float:
@@ -386,6 +469,18 @@ class FleetMetrics:
                 "last_recovery_ms": None if self.last_recovery_s is None
                 else round(self.last_recovery_s * 1e3, 3),
                 "total_recovery_ms": round(self.total_recovery_s * 1e3, 3),
+            },
+            "durability": {
+                "crash_recoveries": self.crash_recoveries,
+                "recovered": self.requests_recovered,
+                "last_crash_recovery_ms":
+                    None if self.last_crash_recovery_s is None
+                    else round(self.last_crash_recovery_s * 1e3, 3),
+                "banked": dict(sorted(self.banked_outcomes.items())),
+                "weight_rolls": self.weight_rolls,
+                "last_roll_ms": None if self.last_roll_s is None
+                else round(self.last_roll_s * 1e3, 3),
+                "model_version": self.model_version,
             },
             "replicas": (self.replicas_cb()
                          if self.replicas_cb is not None else None),

@@ -33,6 +33,15 @@ module):
   the cache always stores contiguous chains; candidates must also be
   idle (refcount 1 — the cache's own ref) or evicting them would free
   nothing.
+- **Version epoch.**  Cached K/V bytes are a function of the *weights*
+  that prefilled them, so a rolling weight hot-swap must make every
+  pre-swap block unhittable: :meth:`bump_epoch` folds a monotonically
+  increasing epoch into the chain-hash ROOT.  A lookup under epoch
+  ``N+1`` can never match an entry registered under epoch ``N`` — the
+  keys live in disjoint hash domains by construction, which is a
+  stronger guarantee than clearing (there is no window where a stale
+  entry is still reachable).  The bump also drops every idle entry so
+  the old-weight blocks return to the pool.
 """
 from __future__ import annotations
 
@@ -73,6 +82,10 @@ class PrefixCache:
         self.allocator = allocator
         self.block_size = int(block_size)
         self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        #: weight-version epoch: folded into every chain-hash root, so
+        #: entries registered under an older epoch are unreachable by
+        #: construction (rolling hot-swap correctness — see module doc)
+        self.epoch = 0
         # counters (exported via Engine metrics)
         self.lookups = 0
         self.hit_blocks_total = 0
@@ -85,7 +98,8 @@ class PrefixCache:
     # -- lookup / register -------------------------------------------------
 
     def _keys_for(self, prompt: np.ndarray, n_blocks: int) -> List[bytes]:
-        bs, keys, parent = self.block_size, [], _ROOT
+        bs, keys = self.block_size, []
+        parent = _ROOT + self.epoch.to_bytes(8, "little")
         for i in range(n_blocks):
             parent = _chain_hash(parent, prompt[i * bs:(i + 1) * bs])
             keys.append(parent)
@@ -207,6 +221,18 @@ class PrefixCache:
             freed += 1
         return freed
 
+    def bump_epoch(self) -> int:
+        """Invalidate every cached block for a weight hot-swap: advance
+        the epoch (new lookups/registrations hash in a disjoint domain —
+        an old-epoch entry can never prefix-hit again) and drop every
+        idle entry so the stale-KV blocks return to the pool.  Entries
+        still pinned by live slots keep their refs until those slots
+        release — they are unreachable either way.  Returns the new
+        epoch."""
+        self.epoch += 1
+        self.clear()
+        return self.epoch
+
     def clear(self) -> int:
         """Drop every entry (releasing the cache's refs).  Returns the
         number of entries dropped."""
@@ -234,6 +260,7 @@ class PrefixCache:
     def stats(self) -> dict:
         return {
             "entries": len(self._entries),
+            "epoch": self.epoch,
             "lookups": self.lookups,
             "hit_blocks": self.hit_blocks_total,
             "hit_tokens": self.hit_tokens_total,

@@ -102,6 +102,17 @@ class FleetRequest:
     #: contract as ``redispatched``, one level down)
     preempted: bool = False
     preemptions: int = 0
+    #: durable identity in the fleet's request journal: stable across
+    #: redispatch AND process crashes (every attempt's admission record
+    #: carries it; the exactly-once audit keys on it)
+    journal_id: Optional[str] = None
+    #: this handle is a crash-recovery replay rehydrated from the
+    #: journal by ``Fleet.recover`` (stream restarted from token 0)
+    recovered: bool = False
+    #: weight version of the replica that admitted the CURRENT attempt
+    model_version: int = 0
+    #: pre-crash admission wall stamp (tracer's cross-process link)
+    _origin_wall: Optional[float] = field(default=None, repr=False)
     #: engine names this request was dispatched to, in order
     replica_history: List[str] = field(default_factory=list)
     t_submit: float = 0.0
@@ -191,16 +202,33 @@ class Fleet:
             Fleet-managed (rejected in ``engine_kwargs``); default: the
             env-armed tracer (``PADDLE_TPU_TRACE=1``) or the no-op
             tracer.
+        journal: a :class:`~.journal.RequestJournal` shared by the
+            router and every replica — submissions are journaled with
+            fleet-scoped ids, the router's exactly-once ``_finish``
+            writes each final terminal record, and a fresh process can
+            ``recover()`` every non-terminal request after a crash.
+            Fleet-managed (rejected in ``engine_kwargs``).
+        isolate_weights: give each replica its OWN parameter buffers
+            (cloned from the template model) so a rolling
+            ``update_weights`` can swap one drained replica while the
+            rest keep serving the old weights.  Default None =
+            auto: isolate when ``num_replicas > 1`` and the model is
+            reconstructible as ``type(model)(model.config)``, else
+            share (where ``update_weights`` degrades to a
+            stop-the-world swap).
         **engine_kwargs: forwarded to every replica's ``Engine(...)``
-            (``num_slots``, ``max_seq``, ``kv_layout``, ...).  ``name``
-            and ``fault_plan`` are fleet-managed and rejected here.
+            (``num_slots``, ``max_seq``, ``kv_layout``, ...).  ``name``,
+            ``fault_plan``, ``tracer``, ``journal`` and
+            ``model_version`` are fleet-managed and rejected here.
     """
 
     def __init__(self, model_or_config, *, num_replicas: int = 2,
                  max_redispatch: int = 2, max_queue: Optional[int] = None,
                  eject_after_failures: int = 2, supervise_every: int = 1,
                  name: Optional[str] = None, fault_plan=None,
-                 tracer=None, **engine_kwargs):
+                 tracer=None, journal=None,
+                 isolate_weights: Optional[bool] = None,
+                 **engine_kwargs):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
@@ -210,11 +238,30 @@ class Fleet:
             raise ValueError("eject_after_failures must be >= 1")
         if supervise_every < 1:
             raise ValueError("supervise_every must be >= 1")
-        for k in ("name", "fault_plan", "tracer"):
+        for k in ("name", "fault_plan", "tracer", "journal",
+                  "model_version"):
             if k in engine_kwargs:
                 raise ValueError(f"{k!r} is fleet-managed; pass it to "
                                  "Fleet, not through engine kwargs")
         self.model = Engine.resolve_model(model_or_config)
+        #: current fleet-wide weight version (bumped by update_weights;
+        #: replicas join rolls — and rebuilds — at this version)
+        self.model_version = 0
+        # weight isolation (docs/SERVING.md "Durability & hot swap"):
+        # each replica serves its OWN parameter buffers, cloned from
+        # the template, so a rolling update can swap one drained
+        # replica while the others keep answering on the old weights —
+        # exactly the memory layout a multi-process deployment has.
+        # isolate_weights=None auto-detects (falls back to the PR 6
+        # shared-weights layout when the model cannot be cloned, where
+        # update_weights degrades to a documented stop-the-world swap).
+        if isolate_weights is None:
+            self._isolate_mode = "auto" if num_replicas > 1 else "off"
+        else:
+            self._isolate_mode = "on" if isolate_weights else "off"
+        # provisional under "auto": the first replica clone attempt
+        # settles it (falls back to shared on an uncloneable model)
+        self.weights_isolated = self._isolate_mode != "off"
         self.name = name or f"fleet-{next(_fleet_counter)}"
         self.num_replicas = int(num_replicas)
         self.max_redispatch = int(max_redispatch)
@@ -234,6 +281,10 @@ class Fleet:
         if tracer is None:
             tracer = RequestTracer.from_env() or NULL_TRACER
         self.tracer = tracer
+        # ONE journal shared by the router and every replica: engine
+        # admissions/tokens/attempt-ends ride fleet-scoped journal ids,
+        # the router's exactly-once _finish writes each final end
+        self.journal = journal
         self.replicas: List[_Replica] = [
             _Replica(k, self._make_engine(k))
             for k in range(self.num_replicas)]
@@ -261,10 +312,41 @@ class Fleet:
 
     # -- replica construction ----------------------------------------------
 
+    def _replica_model(self):
+        """The model a new replica engine serves: a per-replica clone
+        of the template (current weights copied in) under weight
+        isolation — rebuilt as ``type(model)(model.config)``, true for
+        the served GPT/Llama families — else the shared template.
+        Rebuilds after an ejection land here too, so a replica rebuilt
+        mid-roll joins at the template's CURRENT weights."""
+        if not self.weights_isolated:
+            return self.model
+        try:
+            m = type(self.model)(self.model.config)
+        except Exception as e:           # noqa: BLE001 — capability probe
+            if self._isolate_mode == "auto":
+                self.weights_isolated = False
+                return self.model
+            raise TypeError(
+                "isolate_weights=True needs a model reconstructible as "
+                "type(model)(model.config) "
+                f"({type(e).__name__}: {e}); pass isolate_weights=False "
+                "to share weights (rolling update_weights then degrades "
+                "to a stop-the-world swap)") from e
+        from .engine import _write_state_dict
+
+        _write_state_dict(m, self.model.state_dict(),
+                          what="replica model clone")
+        m.eval()
+        return m
+
     def _make_engine(self, index: int) -> Engine:
-        return Engine(self.model, name=f"{self.name}.r{index}",
+        return Engine(self._replica_model(),
+                      name=f"{self.name}.r{index}",
                       fault_plan=self.fault_plan.scoped(index),
-                      tracer=self.tracer, **self._engine_kwargs)
+                      tracer=self.tracer, journal=self.journal,
+                      model_version=self.model_version,
+                      **self._engine_kwargs)
 
     def warmup(self) -> dict:
         """Warm every replica (pre-compile all buckets + decode per
@@ -358,8 +440,18 @@ class Fleet:
                         "to dispatch to")
             # adoption window: the attempt span the engine creates
             # inside this add_request joins the fleet trace, parented on
-            # the previous attempt (the redispatch chain) or the root
+            # the previous attempt (the redispatch chain) or the root;
+            # the journal adoption mirrors it — every attempt's
+            # admission record rides the ONE fleet-scoped journal id
             self.tracer.begin_attempt(freq, rep.engine.name)
+            if self.journal is not None:
+                if freq.journal_id is None:
+                    freq.journal_id = (f"{self.name}:b{self.journal.boot}"
+                                       f":f{freq.request_id}")
+                self.journal.begin_attempt(
+                    freq.journal_id, fleet_owned=True,
+                    recovered=freq.recovered,
+                    origin_wall=freq._origin_wall)
             try:
                 ereq = rep.engine.add_request(
                     freq.prompt_ids, stream_cb=self._wrap_stream(freq),
@@ -381,7 +473,10 @@ class Fleet:
                 continue
             finally:
                 self.tracer.end_attempt()
+                if self.journal is not None:
+                    self.journal.end_attempt()
             freq._attempt = ereq
+            freq.model_version = rep.engine.model_version
             freq.replica_history.append(rep.engine.name)
             self._attempts[ereq] = (freq, rep)
             self.metrics.on_dispatch(affinity_tokens=affinity,
@@ -493,7 +588,9 @@ class Fleet:
         if self.state == "stopped":
             raise EngineStopped(f"fleet {self.name!r} is stopped")
         for rep in list(self.replicas):
-            if rep.state != "active":
+            # "updating" replicas (mid weight-roll drain) keep stepping
+            # their in-flight work; they just receive no new dispatches
+            if rep.state not in ("active", "updating"):
                 continue
             eng = rep.engine
             if (eng.queue or eng.running) and eng.state in (
@@ -551,6 +648,15 @@ class Fleet:
         freq._attempt = None
         self.metrics.on_terminal(state)
         self.tracer.on_fleet_terminal(freq, state, error)
+        if self.journal is not None and freq.journal_id is not None \
+                and self.journal.has_admission(freq.journal_id):
+            # THE one final end per journal id (engine-level retires of
+            # fleet-owned requests were non-final attempt ends); a
+            # rejected submit that never reached an engine admission
+            # was delivered synchronously and is not journaled
+            self.journal.record_end(
+                freq.journal_id, state, final=True, error=freq.error,
+                n_tokens=len(freq.output_ids))
         if freq.done_cb is not None:
             try:
                 freq.done_cb(freq)
@@ -651,7 +757,7 @@ class Fleet:
         replica, then re-dispatch the orphans onto the healed fleet."""
         orphans: List[Tuple[FleetRequest, str]] = []
         for rep in self.replicas:
-            if rep.state != "active":
+            if rep.state not in ("active", "updating"):
                 continue
             h = rep.engine.health()      # also audits paged invariants
             if h["state"] == "unhealthy":
@@ -739,6 +845,205 @@ class Fleet:
         rep._eject_t = None
         self.metrics.on_rebuild(recovery)
         self.tracer.on_rebuild(eng.name, recovery)
+
+    # -- durability: crash recovery & rolling weight hot-swap --------------
+
+    def recover(self, journal=None) -> dict:
+        """Crash-consistent recovery: rehydrate every non-terminal
+        journaled request from a previous process's
+        :class:`~.journal.RequestJournal` and re-dispatch it across the
+        fleet as a replay-from-prompt — ``recovered`` flag set, stream
+        restarting at token 0, seeded from the journaled effective seed
+        (greedy/seeded outputs bitwise identical to an uninterrupted
+        run).  Pre-crash FINAL outcomes are banked into the fleet
+        metrics so completed/failed stay monotone across the restart,
+        and every replayed request keeps its original journal id — the
+        journal-wide exactly-once audit (``duplicate_terminals == 0``)
+        spans the crash.
+
+        Call after ``warmup()``, before new traffic.  Returns
+        ``{"replayed", "requests", "outcomes", "recovery_ms"}``."""
+        journal = journal if journal is not None else self.journal
+        if journal is None:
+            raise ValueError("recover() needs a RequestJournal (pass "
+                             "journal= here or to the Fleet)")
+        if self.state != "active":
+            raise EngineStopped(
+                f"fleet {self.name!r} is {self.state}: cannot recover")
+        if self._attempts or self._repatriate or any(
+                rep.engine.queue or rep.engine.running
+                for rep in self.replicas
+                if rep.state in ("active", "updating")):
+            # recovery on a LIVE fleet would re-dispatch every request
+            # that is still in flight under its own journal id — a
+            # guaranteed duplicate terminal (the engine-level recover
+            # has the same guard)
+            raise RuntimeError(
+                "recover() must run before serving traffic: the fleet "
+                f"has {self.pending} request(s) in flight whose journal "
+                "ids the replay would duplicate")
+        if self.journal is None:
+            self.journal = journal
+            for rep in self.replicas:
+                rep.engine.journal = journal
+        elif journal is not self.journal:
+            # replaying journal B while recording into journal A would
+            # leave B's pending set non-converging forever (a later
+            # recover from B replays completed work again): one journal
+            # per fleet, attached everywhere
+            raise ValueError(
+                "recover(journal=...) does not match the journal this "
+                "fleet records into; recover into the SAME journal the "
+                "fleet was constructed with (or construct the fleet "
+                "with the journal being recovered)")
+        t0 = time.perf_counter()
+        outcomes = journal.outcomes()
+        self.metrics.bank_outcomes(outcomes)
+        replayed = []
+        for jid, rec in journal.pending().items():
+            replayed.append(self._submit_recovered(jid, rec))
+        dt = time.perf_counter() - t0
+        self.metrics.on_crash_recovery(len(replayed), dt)
+        return {"replayed": len(replayed), "requests": replayed,
+                "outcomes": outcomes,
+                "recovery_ms": round(dt * 1e3, 3)}
+
+    def _submit_recovered(self, jid: str, rec: dict) -> FleetRequest:
+        """One journal replay: a fresh fleet handle carrying the
+        ORIGINAL journal id and the journaled replay recipe, dispatched
+        outside the fleet ``max_queue`` bound (this work was already
+        accepted once — recovery must not shed it on backpressure)."""
+        s = self.journal.replay_sampling(rec)
+        kwargs = {"max_new_tokens": rec["max_new_tokens"],
+                  "eos_token_id": rec["eos_token_id"],
+                  "deadline_s": rec["deadline_s"],
+                  "priority": rec["priority"],
+                  "sampling": SamplingParams(**s)}
+        freq = FleetRequest(
+            prompt_ids=np.asarray(rec["prompt_ids"],
+                                  dtype=np.int64).reshape(-1),
+            request_id=next(self._req_counter), kwargs=kwargs)
+        freq.journal_id = jid
+        freq.recovered = True
+        freq._origin_wall = rec.get("wall")
+        freq.t_submit = time.perf_counter()
+        freq._fleet = weakref.ref(self)
+        self.metrics.on_submit()
+        self.tracer.on_submitted(freq, self.name)
+        try:
+            self._dispatch(freq)
+        except (QueueFull, EngineStopped) as e:
+            # the handle still terminates exactly once: a replay no
+            # replica can take fails with the reason recorded
+            if not freq.done:
+                self._finish(freq, "failed",
+                             error=f"recovery dispatch found no "
+                                   f"replica: {e}")
+        except ValueError:
+            pass                         # _dispatch already rejected it
+        return freq
+
+    def update_weights(self, state_or_path, *,
+                       max_drain_steps: Optional[int] = None) -> dict:
+        """Zero-downtime rolling weight hot-swap.
+
+        Under weight isolation (the default for multi-replica fleets),
+        replicas are taken out of dispatch rotation ONE AT A TIME
+        (state ``updating``), drained of their in-flight work — the
+        rest of the fleet keeps answering on the old weights the whole
+        time — then swapped in place: the new weights are written
+        *through* each replica's existing parameter buffers
+        (``Engine.update_weights`` → ``set_state_dict`` write-through),
+        so every warmed executable and its lifted state stay valid and
+        ZERO new compile keys appear.  Each swap bumps the replica's
+        prefix-cache version epoch (a request can never prefix-hit KV
+        blocks prefilled under older weights) and its ``model_version``
+        tag.  The template model is updated FIRST so a replica ejected
+        and rebuilt mid-roll comes back at the new version.
+
+        With shared weights (``isolate_weights=False`` or an
+        uncloneable model) there is one parameter set, so the roll
+        degrades to a documented stop-the-world swap: every replica is
+        drained together, then the single write lands.
+
+        ``max_drain_steps`` bounds each drain (RuntimeError past it —
+        the fleet is left serving, partially rolled, with versions
+        telling which replica serves what).  Accepts the same weight
+        sources as ``Engine.update_weights``.  Returns
+        ``{"model_version", "replicas_updated", "roll_ms"}``."""
+        from .engine import _resolve_weights, _write_state_dict
+
+        if self.state != "active":
+            raise EngineStopped(
+                f"fleet {self.name!r} is {self.state}: cannot roll "
+                "weights")
+        sd = _resolve_weights(state_or_path)
+        new_version = self.model_version + 1
+        t0 = time.perf_counter()
+        updated = 0
+        if self.weights_isolated:
+            _write_state_dict(self.model, sd)
+            self.model_version = new_version
+            for rep in list(self.replicas):
+                if rep.state != "active":
+                    continue             # ejected/dead: rebuilds join
+                rep.state = "updating"   # at the new template weights
+                try:
+                    self._drain_replica(rep, max_drain_steps)
+                    if rep.state == "updating":
+                        rep.engine.update_weights(sd,
+                                                  version=new_version)
+                        updated += 1
+                finally:
+                    if rep.state == "updating":
+                        rep.state = "active"
+        else:
+            # stop-the-world fallback: ONE shared parameter set means
+            # no replica can keep serving old weights while another
+            # swaps — drain everything, then write once
+            marked = [r for r in self.replicas if r.state == "active"]
+            for rep in marked:
+                rep.state = "updating"
+            try:
+                for rep in marked:
+                    self._drain_replica(rep, max_drain_steps)
+            finally:
+                for rep in marked:
+                    if rep.state == "updating":
+                        rep.state = "active"
+            _write_state_dict(self.model, sd)
+            self.model_version = new_version
+            for rep in marked:
+                # ONE write through the shared buffers (above); each
+                # engine still gets its own epoch/version bookkeeping
+                if rep.state == "active" and not (
+                        rep.engine.queue or rep.engine.running):
+                    rep.engine._mark_weights_swapped(new_version)
+                    updated += 1
+        dt = time.perf_counter() - t0
+        self.metrics.on_weight_roll(new_version, dt)
+        self.tracer.on_weight_roll(self.name, new_version, dt, updated)
+        return {"model_version": new_version,
+                "replicas_updated": updated,
+                "roll_ms": round(dt * 1e3, 3)}
+
+    def _drain_replica(self, rep: _Replica,
+                       max_drain_steps: Optional[int]) -> None:
+        """Drive fleet steps until ``rep`` holds no queued or running
+        work (the whole fleet — this replica's in-flight requests
+        included — keeps stepping; only new dispatches avoid it).  An
+        ejection mid-drain exits early: the rebuilt engine is empty."""
+        n = 0
+        while rep.state == "updating" and (rep.engine.queue or
+                                           rep.engine.running):
+            self.step()
+            n += 1
+            if max_drain_steps is not None and n >= max_drain_steps:
+                raise RuntimeError(
+                    f"replica {rep.engine.name!r} did not drain within "
+                    f"{max_drain_steps} fleet steps (still "
+                    f"{len(rep.engine.running)} running, "
+                    f"{len(rep.engine.queue)} queued)")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -879,6 +1184,9 @@ class Fleet:
         out = self.metrics.snapshot()
         out["state"] = self.state
         out["pending"] = self.pending
+        out["durability"]["weights_isolated"] = self.weights_isolated
+        if self.journal is not None:
+            out["durability"]["journal"] = self.journal.stats()
         out["overload"] = self._overload_section()
         if self.tracer.enabled:
             out["tracing"] = self.tracer.snapshot()

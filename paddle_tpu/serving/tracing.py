@@ -64,7 +64,18 @@ from typing import Dict, List, Optional
 from ..obs.flight import FlightRecorder  # noqa: F401  (re-export)
 
 __all__ = ["RequestTracer", "NullTracer", "NULL_TRACER", "FlightRecorder",
-           "validate_trace", "TERMINAL_SPAN_STATES"]
+           "validate_trace", "TERMINAL_SPAN_STATES", "live_tracers"]
+
+#: weak registry of every live enabled tracer — the crash-dump path
+#: (:mod:`paddle_tpu.obs.crashdump`) persists armed traces before a
+#: hard process exit, and must find them without holding them alive
+_LIVE_TRACERS = weakref.WeakSet()
+
+
+def live_tracers():
+    """Every live :class:`RequestTracer` in the process (weakly held,
+    registration order not guaranteed) — the crash-dump surface."""
+    return list(_LIVE_TRACERS)
 
 #: States an attempt span may legally end in.  ``preempted`` and
 #: ``exported`` are *non-final* ends — the request continues on a child
@@ -143,6 +154,7 @@ class RequestTracer:
         #: pending adoption set by the router around one add_request
         #: call: ``(fleet_request, trace_id, parent_span)``
         self._pending = None
+        _LIVE_TRACERS.add(self)
 
     # -- construction -------------------------------------------------------
 
@@ -304,6 +316,37 @@ class RequestTracer:
 
     def on_unhealthy(self, replica: str, reason: str) -> None:
         self._event("unhealthy", replica=replica, reason=reason)
+
+    def on_recovered(self, req, replica: str,
+                     origin_wall: Optional[float] = None,
+                     journal_id: Optional[str] = None) -> None:
+        """A crash-recovery replay re-admitted this request from the
+        journal.  The attempt span is its cross-process *resume span*;
+        the link back to the pre-crash attempt is WALL-anchored
+        (``origin_wall`` = the journaled original admission's wall
+        stamp) because monotonic clocks do not survive a restart — the
+        Perfetto exporter renders it as a flow arrow from a synthetic
+        pre-crash instant into this span."""
+        sid = self._attempt_span_for(req, replica)
+        sp = self.spans.get(sid)
+        if sp is not None:
+            sp["recovered"] = True
+        self._event("recovered", trace=self._req_trace.get(req), span=sid,
+                    replica=replica, request_id=req.request_id,
+                    journal_id=journal_id,
+                    **({"origin_wall": round(origin_wall, 6)}
+                       if origin_wall is not None else {}))
+
+    def on_weight_swap(self, replica: str, version: int) -> None:
+        """One replica finished its drain-and-swap: every admission on
+        it from here serves model ``version``."""
+        self._event("weight_swap", replica=replica, version=version)
+
+    def on_weight_roll(self, fleet: str, version: int,
+                       roll_s: float, replicas: int) -> None:
+        """The fleet-level rolling update completed end to end."""
+        self._event("weight_roll", replica=fleet, version=version,
+                    roll_ms=round(roll_s * 1e3, 3), replicas=replicas)
 
     # -- fleet-facing hooks -------------------------------------------------
 

@@ -116,6 +116,17 @@ def test_serving_mode_emits_json_line():
     # gates above — the traced run added no steady-state compiles
     assert out["serving_trace_events"] > 0
     assert out["serving_trace_valid"] == 1.0
+    # durability drills (ISSUE 14): the crash-recovery drill replayed
+    # real in-flight work from the journal and finished it (bench fails
+    # structured on any lost request, duplicate terminal, or recovery
+    # compile miss), and the rolling hot-swap completed at version 1
+    # with the worst per-request inter-token gap measured across the
+    # roll (>= 0; stall-free is legal, lost traffic is not)
+    assert out["serving_recovery_ms"] > 0
+    assert out["serving_journal_replayed"] >= 1
+    assert out["serving_hot_swap_stall_ms"] >= 0
+    assert out["serving_hot_swap_roll_ms"] > 0
+    assert out["serving_hot_swap_model_version"] == 1
 
 
 def test_preflight_failure_is_structured():

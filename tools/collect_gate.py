@@ -153,6 +153,8 @@ TIER1_CRITICAL = {
         "divergence-sentry detection/rollback and bitwise parity",
     "tests/test_train_obs.py":
         "training step observatory (timeline/compile/cost ledgers)",
+    "tests/test_durability.py":
+        "request journal, crash recovery & rolling weight hot-swap",
 }
 
 
