@@ -404,6 +404,75 @@ def _paged_kernel_microbench(model):
     }
 
 
+def _spec_decode_drill(model):
+    """Speculative-decoding drill (ISSUE 15): the same greedy workload
+    through a plain paged engine and a speculative one (tiny 1-layer
+    independent draft + the small target, ``k=4``).  Greedy outputs
+    must agree BITWISE (every emitted speculative token is the target
+    argmax at its position, whatever the draft proposed), both modes
+    must hold zero steady-state compile misses, and the acceptance
+    machinery must actually fire (``serving_spec_accept_rate`` > 0).
+    The tokens/sec pair is the tracked trajectory: on CPU with a
+    random-weight draft the acceptance rate prices the draft overhead
+    honestly (~30% acceptance); the multiplicative
+    win arrives with a distilled draft on real hardware, where k
+    accepted tokens cost one target-window forward instead of k
+    sequential target steps."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving import Engine, SpecConfig
+
+    FAIL_METRIC = "serving_gpt_tiny_decode_tokens_per_sec"
+    paddle.seed(17)
+    draft = GPTForCausalLM(GPTConfig(
+        vocab_size=model.config.vocab_size, hidden_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=model.config.max_position_embeddings,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, 128, (L,)).tolist() for L in (7, 15, 26, 4)]
+    runs = {}
+    for mode in ("nospec", "spec"):
+        kw = {} if mode == "nospec" else dict(
+            speculation=SpecConfig(draft_model=draft, k=4))
+        eng = Engine(model, num_slots=4, max_seq=64, min_bucket=8,
+                     kv_layout="paged", block_size=8, **kw)
+        eng.warmup()
+        eng.generate(prompts, max_new_tokens=4)     # prime steady state
+        m0 = eng.metrics.compile_misses
+        reqs = [eng.add_request(p, max_new_tokens=24) for p in prompts]
+        eng.run()
+        st = eng.stats()
+        if eng.metrics.compile_misses != m0:
+            fail_structured(
+                f"speculative drill ({mode}) recompiled in steady "
+                f"state: {st['compile_cache']}", metric=FAIL_METRIC)
+        if any(not r.finished for r in reqs):
+            fail_structured(
+                f"speculative drill ({mode}) left unfinished requests",
+                metric=FAIL_METRIC)
+        runs[mode] = ([r.output_ids for r in reqs], st)
+    if runs["spec"][0] != runs["nospec"][0]:
+        fail_structured("speculative greedy outputs diverge from the "
+                        "non-speculative run", metric=FAIL_METRIC)
+    st = runs["spec"][1]
+    sp = st["speculation"]
+    if not sp["rounds"] or sp["accept_rate"] <= 0.0:
+        fail_structured(
+            f"speculative drill accepted nothing: {sp}",
+            metric=FAIL_METRIC)
+    return {
+        "serving_spec_accept_rate": sp["accept_rate"],
+        "serving_spec_tokens_per_round": round(
+            st["tokens"]["decode"] / sp["rounds"], 4),
+        "serving_spec_tokens_per_sec": st["decode_tokens_per_sec"],
+        "serving_nospec_tokens_per_sec":
+            runs["nospec"][1]["decode_tokens_per_sec"],
+    }
+
+
 def _durability_drill(model):
     """Crash-recovery drill (ISSUE 14): an engine journals live traffic
     into a :class:`RequestJournal` and is ABANDONED mid-decode (the
@@ -684,6 +753,9 @@ def serving_main():
     # -- paged-kernel vs reference-gather decode microbench --------------
     kernel_bench = _paged_kernel_microbench(model)
 
+    # -- speculative decoding: tiny-draft propose / bucketed verify ------
+    spec_bench = _spec_decode_drill(model)
+
     # -- overload trace-replay: priorities vs the no-priority baseline ---
     trace = _trace_replay(model)
 
@@ -728,6 +800,13 @@ def serving_main():
         # tracks the Pallas flash-decoding path against the jnp gather
         # oracle (interpret-mode number off-TPU)
         **kernel_bench,
+        # speculative decoding (ISSUE 15): greedy bitwise vs the
+        # non-speculative run enforced, zero steady-state misses in
+        # BOTH modes enforced; accept rate × tokens/round are the
+        # efficiency trajectory, the tokens/sec pair the honest CPU
+        # comparison (a random-weight draft prices the overhead; the
+        # win needs a distilled draft + hardware)
+        **spec_bench,
         # paged KV + prefix reuse (ISSUE 5): the shared-prefix workload
         # through both layouts — hit rate must be > 0, and the paged
         # TTFT reflects prefilling only the uncached tail bucket

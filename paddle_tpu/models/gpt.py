@@ -131,10 +131,12 @@ class GPTAttention(Layer):
             # the paged layout (the tail bucket attends onto shared blocks)
             cache_ctx.write_prefill(k, v)
             ctx = cache_ctx.prefill_attention(q, k, v)
-        else:                                   # decode: S == 1 per slot
+        else:               # decode (S == 1) or verify (S == k+1) window
             # write + attend routed through the context: the paged cache
             # may stream blocks through the Pallas flash-decoding kernel
-            # instead of gathering a contiguous copy (ROADMAP item 2)
+            # instead of gathering a contiguous copy (ROADMAP item 2);
+            # verify mode routes the same call to the cache's W-token
+            # speculative window attention — models stay single-path
             ctx = cache_ctx.decode_attention(q, k, v)
         ctx = mark_sharding(ctx, P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None))
         ctx = ctx.reshape([B, S, self.n_heads * self.head_dim])
@@ -213,8 +215,10 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, cache_ctx=None):
         if cache_ctx is not None and position_ids is None:
-            if cache_ctx.mode == "decode":
-                # each slot's single token sits at that slot's own offset
+            if cache_ctx.mode != "prefill":
+                # decode: each slot's single token sits at that slot's
+                # own offset ([slots, 1]); verify: the speculative
+                # window's k+1 tokens likewise ([slots, k+1])
                 position_ids = cache_ctx.positions()
             else:
                 # paged tail prefill: tokens sit past the cached prefix

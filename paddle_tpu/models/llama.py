@@ -173,16 +173,18 @@ class LlamaAttention(Layer):
         if self._rope is None or self._rope[0].shape[0] < S:
             self._rope = _rope_cache(max(S, self.max_pos), self.head_dim,
                                      self.rope_theta)
-        if cache_ctx is not None and cache_ctx.mode == "decode":
-            # position-offset rotary: gather the FULL tables at each slot's
-            # current offset (the single query token is not at position 0)
+        if cache_ctx is not None and cache_ctx.mode != "prefill":
+            # position-offset rotary: gather the FULL tables at each
+            # slot's current offset — decode's single query token (and
+            # verify's k+1-token speculative window) is not at position 0
             cos = Tensor._wrap(jnp.asarray(self._rope[0]))
             sin = Tensor._wrap(jnp.asarray(self._rope[1]))
             q, k = _rotary_embedding(q, k, cos, sin,
                                      position_ids=cache_ctx.positions())
             # cache stores post-rotary K (and V) at kv-head granularity;
             # write + attend routed through the context (the paged cache
-            # may run the Pallas flash-decoding kernel over its blocks)
+            # may run the Pallas flash-decoding kernel over its blocks;
+            # verify mode routes to the W-token window attention)
             ctx = cache_ctx.decode_attention(q, k, v)
         else:
             pos = None if cache_ctx is None else \

@@ -131,6 +131,18 @@ def chrome_trace(tracer) -> dict:
                         "ts": _us(ev["ts"]), "name": "active_slots",
                         "args": {"active": ev["n_active"]}})
             continue
+        if kind == "verify_step":
+            # speculative round: the active-slots counter plus an
+            # accepted_tokens counter track riding next to it — the
+            # per-round acceptance story as a waveform
+            pid = tracks.pid(replica)
+            out.append({"ph": "C", "pid": pid, "tid": SCHEDULER_TID,
+                        "ts": _us(ev["ts"]), "name": "active_slots",
+                        "args": {"active": ev["n_active"]}})
+            out.append({"ph": "C", "pid": pid, "tid": SCHEDULER_TID,
+                        "ts": _us(ev["ts"]), "name": "accepted_tokens",
+                        "args": {"accepted": ev["accepted"]}})
+            continue
         sp = tracer.spans.get(ev.get("span"))
         slot = sp.get("slot") if sp is not None else None
         thread = ev.get("thread") or (sp.get("thread") if sp else None)

@@ -26,7 +26,7 @@ from ..core.dispatch import apply_op
 
 __all__ = ["cached_attention", "gather_block_kv",
            "block_prefill_attention", "paged_decode_attention",
-           "paged_prefill_attention"]
+           "paged_prefill_attention", "verify_attention"]
 
 
 def cached_attention(query, k_cache, v_cache, lengths, name=None):
@@ -61,6 +61,54 @@ def cached_attention(query, k_cache, v_cache, lengths, name=None):
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
     return apply_op("cached_attention", _primal,
+                    [query, k_cache, v_cache, lengths])
+
+
+def verify_attention(query, k_cache, v_cache, lengths, name=None):
+    """Speculative-decoding verify attention: W tokens per slot in one
+    fixed-shape step (:func:`cached_attention` generalized from W = 1).
+
+    The verify step of a draft-propose / target-verify round scores the
+    last emitted token plus the k draft proposals — W = k + 1 query
+    tokens per slot sitting at absolute positions
+    ``lengths[b] .. lengths[b] + W - 1`` — against the slot's cache in
+    ONE forward, so speculation adds a single compiled program instead
+    of k sequential target steps.
+
+    Args:
+        query:   ``[B, W, H, D]`` — the verify window's queries.
+        k_cache: ``[B, T, Hkv, D]`` — per-slot key cache (one layer),
+                 positions ``0..lengths[b]+W-1`` valid (the window's
+                 K/V already written by the caller).
+        v_cache: ``[B, T, Hkv, D]`` — per-slot value cache.
+        lengths: ``[B]`` int32 — absolute position of the window's
+                 FIRST query; query ``i`` attends ``0..lengths[b]+i``
+                 inclusive (the causal mask, per-slot offset).
+
+    Returns:
+        ``[B, W, H, D]`` context tensor.  GQA kv heads repeat
+        consecutively inside, matching :func:`cached_attention`
+        bit-for-bit at W = 1.
+    """
+
+    def _primal(q, k, v, ln):
+        B, W, H, D = q.shape
+        T, Hkv = k.shape[1], k.shape[2]
+        if Hkv != H:
+            rep = H // Hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scale = 1.0 / (D ** 0.5)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        logits = logits.astype(jnp.float32)
+        qpos = ln[:, None] + jnp.arange(W, dtype=ln.dtype)[None, :]  # [B,W]
+        kpos = jnp.arange(T, dtype=ln.dtype)                         # [T]
+        valid = kpos[None, None, :] <= qpos[:, :, None]              # [B,W,T]
+        logits = jnp.where(valid[:, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    return apply_op("verify_attention", _primal,
                     [query, k_cache, v_cache, lengths])
 
 

@@ -16,6 +16,15 @@ families (``models.GPTForCausalLM`` / ``models.LlamaForCausalLM``):
   exported as a ``/stats``-style dict and via
   ``paddle_tpu.profiler.serving_stats()``.
 
+Speculative decoding is an opt-in multiplier on the decode loop
+(``Engine(speculation=SpecConfig(draft_model=..., k=4))``): a small
+draft model proposes k tokens per round, one fixed-shape
+``[slots, k+1]`` verify step scores them all through the same cache
+machinery, and device-side rejection-sampling acceptance keeps the
+longest valid prefix plus a bonus token — greedy output bitwise equal
+to plain decoding, seeded sampling distribution-preserving, zero new
+host transfers per round — see docs/SERVING.md "Speculative decoding".
+
 The engine degrades per-request, never per-engine: terminal states
 ``failed | cancelled | rejected`` with recorded errors, wall-clock
 deadlines, bounded-queue backpressure (:class:`QueueFull`), bounded step
@@ -78,6 +87,7 @@ from .engine import (  # noqa: F401
     Engine, Request, QueueFull, ShedReject, EngineStopped,
     PRIORITY_LOW, PRIORITY_NORMAL, PRIORITY_HIGH,
 )
+from .spec_decode import SpecConfig, SpecState  # noqa: F401
 from .router import Fleet, FleetRequest  # noqa: F401
 
 __all__ = ["KVCache", "CacheContext", "Engine", "Request",
@@ -90,4 +100,5 @@ __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "Fleet", "FleetRequest", "FleetMetrics", "SyncSanitizer",
            "RequestTracer", "NullTracer", "NULL_TRACER",
            "FlightRecorder", "validate_trace",
-           "RequestJournal", "JournalCorrupt"]
+           "RequestJournal", "JournalCorrupt",
+           "SpecConfig", "SpecState"]

@@ -357,6 +357,16 @@ class Engine:
         model_version: initial weight version tag (bumped in place by
             ``update_weights``; each request records the version that
             served it).
+        speculation: a :class:`~.spec_decode.SpecConfig` opting this
+            engine into speculative decoding (draft-model propose, one
+            bucketed ``[slots, k+1]`` verify step, device-side
+            rejection-sampling accept).  Off (None) by default — the
+            decode loop is unchanged.  When on, ``step()`` becomes
+            round-based: k draft steps + one verify step per scheduler
+            tick, emitting 1..k+1 tokens per slot per round.  Greedy
+            output stays bitwise identical to non-speculative decoding;
+            seeded sampling stays distribution-preserving — see
+            docs/SERVING.md "Speculative decoding".
     """
 
     def __init__(self, model, *, num_slots: int = 4,
@@ -381,7 +391,8 @@ class Engine:
                  tracer=None,
                  flight_recorder_steps: int = 256,
                  journal=None,
-                 model_version: int = 0):
+                 model_version: int = 0,
+                 speculation=None):
         cfg = getattr(model, "config", None)
         if cfg is None:
             raise TypeError("Engine needs a model carrying a .config "
@@ -475,9 +486,25 @@ class Engine:
         # lifted into the compiled steps like KV cache state — the token
         # lane IS the next decode step's input ids (no host round-trip)
         self.sampler = DeviceSampler(self.num_slots)
+        # speculative decoding (opt-in, docs/SERVING.md "Speculative
+        # decoding"): the draft model + its KV pool + proposal lanes;
+        # None keeps the plain one-token decode loop
+        self.spec = None
+        if speculation is not None:
+            from .spec_decode import SpecState
+
+            self.spec = SpecState(self, speculation)
+            self.metrics.spec_cb = self.spec.snapshot
         self._req_counter = itertools.count()
         self._prefill_fn = None
         self._decode_fn = None
+        self._draft_prefill_fn = None
+        self._draft_decode_fn = None
+        self._verify_fn = None
+        #: registered compiled program sets: ``(name, warm_fn)`` —
+        #: ``warmup()`` drives every entry so no registered program
+        #: (target OR draft/verify) is ever a cold compile in serving
+        self._warmers: List[tuple] = []
         # resilience / lifecycle
         self.max_queue = None if max_queue is None else int(max_queue)
         self.queue_policy = queue_policy
@@ -601,7 +628,72 @@ class Engine:
             return Tensor._wrap(toks)
 
         self._prefill_fn = jit_mod.to_static(prefill_step)
-        self._decode_fn = jit_mod.to_static(decode_step)
+        self._warmers = [("prefill", self._warm_prefill)]
+        if self.spec is None:
+            self._decode_fn = jit_mod.to_static(decode_step)
+            self._warmers.append(("decode", self._warm_decode))
+        else:
+            # round-based speculative serving replaces the plain decode
+            # program entirely: draft prefill per bucket, ONE draft
+            # decode (proposal column j is an argument), ONE verify
+            self._draft_prefill_fn = jit_mod.to_static(
+                self.spec.make_draft_prefill(self))
+            self._draft_decode_fn = jit_mod.to_static(
+                self.spec.make_draft_decode(self))
+            self._verify_fn = jit_mod.to_static(
+                self.spec.make_verify(self))
+            self._warmers.extend([
+                ("draft_prefill", self._warm_draft_prefill),
+                ("draft_decode", self._warm_draft_decode),
+                ("verify", self._warm_verify),
+            ])
+
+    # -- warmup routines (one per registered program set) ------------------
+
+    def _warm_prefill(self, buckets) -> None:
+        for b in buckets:
+            ids = np.zeros((1, int(b)), dtype=np.int64)
+            if self.kv_layout == "paged":
+                # dummy admission into slot 0: real block assignment so
+                # the traced table reads see representative state, then
+                # released — warmup registers nothing in the prefix cache
+                if not self.cache.begin_sequence(0, [], 0, int(b)):
+                    raise RuntimeError(
+                        f"warmup: pool of {self.cache.num_blocks} blocks "
+                        f"cannot hold one bucket-{b} prefill")
+                try:
+                    self._call_counted(
+                        self._prefill_fn, to_tensor(ids),
+                        to_tensor(np.int32(0)), to_tensor(np.int32(1)),
+                        to_tensor(np.int32(0)))
+                finally:
+                    self.cache.release_slot(0)
+            else:
+                self._call_counted(
+                    self._prefill_fn, to_tensor(ids),
+                    to_tensor(np.int32(0)), to_tensor(np.int32(1)))
+
+    def _warm_decode(self, buckets) -> None:
+        idle = np.zeros((self.num_slots,), dtype=np.int32)
+        self._call_counted(self._decode_fn, to_tensor(idle))
+
+    def _warm_draft_prefill(self, buckets) -> None:
+        for b in buckets:
+            ids = np.zeros((1, int(b)), dtype=np.int64)
+            self._call_counted(
+                self._draft_prefill_fn, to_tensor(ids),
+                to_tensor(np.int32(0)), to_tensor(np.int32(1)))
+
+    def _warm_draft_decode(self, buckets) -> None:
+        idle = np.zeros((self.num_slots,), dtype=np.int32)
+        self._call_counted(self._draft_decode_fn, to_tensor(idle),
+                           to_tensor(np.int32(0)))
+
+    def _warm_verify(self, buckets) -> None:
+        idle = np.zeros((self.num_slots,), dtype=np.int32)
+        cap = np.ones((self.num_slots,), dtype=np.int32)
+        self._call_counted(self._verify_fn, to_tensor(idle),
+                           to_tensor(cap))
 
     def _call_counted(self, fn, *args):
         """Run a compiled step, feeding the executable cache's own state
@@ -907,9 +999,17 @@ class Engine:
         return req
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
-        """Pre-compile the decode step and every prefill bucket with dummy
-        traffic, then reset the cache — so live serving starts with a hot
-        executable cache and zero steady-state misses."""
+        """Pre-compile EVERY registered compiled program set with dummy
+        traffic, then reset all per-slot state — so live serving starts
+        with a hot executable cache and zero steady-state misses.
+
+        The registry (``_warmers``, built by ``_build_steps``) covers
+        the target's prefill buckets and decode step AND, with
+        speculation on, the draft model's prefill buckets, the draft
+        decode step, and the verify step — so the first speculative
+        round is never a cold compile (assert it via
+        ``stats()["compile_cache"]``: the miss counter must not move
+        after warmup)."""
         if self.running or self.queue:
             raise RuntimeError("warmup() must run before serving traffic "
                                "(it scribbles over slot 0 and resets all "
@@ -918,32 +1018,15 @@ class Engine:
             raise EngineStopped(f"engine {self.name!r} is {self.state}")
         if self._prefill_fn is None:
             self._build_steps()
-        for b in (buckets or self.buckets):
-            ids = np.zeros((1, int(b)), dtype=np.int64)
-            if self.kv_layout == "paged":
-                # dummy admission into slot 0: real block assignment so
-                # the traced table reads see representative state, then
-                # released — warmup registers nothing in the prefix cache
-                if not self.cache.begin_sequence(0, [], 0, int(b)):
-                    raise RuntimeError(
-                        f"warmup: pool of {self.cache.num_blocks} blocks "
-                        f"cannot hold one bucket-{b} prefill")
-                try:
-                    self._call_counted(
-                        self._prefill_fn, to_tensor(ids),
-                        to_tensor(np.int32(0)), to_tensor(np.int32(1)),
-                        to_tensor(np.int32(0)))
-                finally:
-                    self.cache.release_slot(0)
-            else:
-                self._call_counted(
-                    self._prefill_fn, to_tensor(ids),
-                    to_tensor(np.int32(0)), to_tensor(np.int32(1)))
-        idle = np.zeros((self.num_slots,), dtype=np.int32)
-        self._call_counted(self._decode_fn, to_tensor(idle))
+        use = list(buckets or self.buckets)
+        for _name, warm in self._warmers:
+            warm(use)
         self.cache.reset()
         self.sampler.reset()             # warmup scribbled slot 0's lanes
-        return {"buckets": list(buckets or self.buckets),
+        if self.spec is not None:
+            self.spec.reset()
+        return {"buckets": use,
+                "programs": [name for name, _ in self._warmers],
                 "compile_misses": self.metrics.compile_misses}
 
     # -- scheduling --------------------------------------------------------
@@ -1100,6 +1183,10 @@ class Engine:
                 self._mark_block_corruption(
                     f"release_slot({slot}) failed on preemption: "
                     f"{type(e).__name__}: {e}")
+        if self.spec is not None:
+            # draft KV is never resumed — the replay-from-prompt resume
+            # re-prefills it (draft state is deliberately not durable)
+            self.spec.release_slot(slot)
         victim.slot = None
         victim.state = "queued"
         victim.preempted = True
@@ -1307,6 +1394,8 @@ class Engine:
                 to_tensor(np.int32(L)))
             if tok_t is None:
                 return None
+        if self.spec is not None and not self._spec_admit(req, L):
+            return None
         now = time.perf_counter()
         self.metrics.prefill_time_s += now - t0
         req.state, req.prefill_bucket = "running", bucket
@@ -1317,6 +1406,34 @@ class Engine:
         self.tracer.on_admitted(req, self.name, bucket, req.slot,
                                 prefix_hit)
         self._deliver_first_token(req, tok_t, now)
+
+    def _spec_admit(self, req: Request, L: int) -> bool:
+        """Draft-side half of a speculating admission: stage the draft
+        sampler lanes (params + salt-derived seed — identically on
+        first admission, preempt-resume, and crash-recovery replay, the
+        determinism contract) and prefill the prompt into the draft
+        cache.  The draft always prefills its full-prompt bucket — it
+        keeps no prefix cache; draft KV is cheap and deliberately not
+        durable.  Failure retires the request (replica-implicated, like
+        any compiled-step failure) and returns False."""
+        self.spec.stage_slot(req.slot, req.sampling, self._seed_for(req))
+        bucket = self.bucket_for(L)
+        ids = np.zeros((1, bucket), dtype=np.int64)
+        ids[0, :L] = req.prompt_ids
+        try:
+            self._step_call("serving.spec_draft_prefill",
+                            self._draft_prefill_fn, to_tensor(ids),
+                            to_tensor(np.int32(req.slot)),
+                            to_tensor(np.int32(L)))
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            n = self.max_step_retries
+            self._retire(req, "failed",
+                         error=f"draft prefill failed after {n} "
+                               f"retr{'y' if n == 1 else 'ies'}: "
+                               f"{type(e).__name__}: {e}",
+                         kind="replica")
+            return False
+        return True
 
     def _deliver_first_token(self, req: Request, tok_t, now: float
                              ) -> None:
@@ -1379,6 +1496,8 @@ class Engine:
                     self._mark_block_corruption(
                         f"release_slot({slot}) failed: "
                         f"{type(e).__name__}: {e}")
+            if self.spec is not None:
+                self.spec.release_slot(slot)
         if state == "finished":
             self.metrics.on_complete()
         elif state == "cancelled":
@@ -1430,17 +1549,26 @@ class Engine:
                                    "(even after prefix-cache eviction)")
 
     def _decode(self) -> None:
-        """One decode step.  The *dispatch* (``_decode_body``) runs under
-        the sanitizer's counting window when armed
-        (``PADDLE_TPU_SANITIZE``): every framework-level host coercion
-        inside is counted and attributed to its source line — 0.0 since
-        ROADMAP item 2 moved sampling on-device (the PR 7 baseline was
-        the 1.0 per-step logits pull).  Stream *delivery* — pulling the
-        sampled ``[slots] int32`` token array for callbacks and stop
-        checks — happens after the window closes: the next step's inputs
-        already live on device (the sampler's token lane), so the pull
-        is not on the dispatch critical path."""
+        """One decode step (or, with speculation on, one ROUND: k draft
+        steps + one verify step).  The *dispatch* (``_decode_body`` /
+        ``_spec_round_body``) runs under the sanitizer's counting window
+        when armed (``PADDLE_TPU_SANITIZE``): every framework-level host
+        coercion inside is counted and attributed to its source line —
+        0.0 since ROADMAP item 2 moved sampling on-device (the PR 7
+        baseline was the 1.0 per-step logits pull), and speculation
+        keeps it 0.0 (proposals chain device-side, acceptance is
+        in-graph).  Stream *delivery* — pulling the sampled ``[slots]``
+        (or per-round ``[slots, k+2]``) int32 array for callbacks and
+        stop checks — happens after the window closes: the next step's
+        inputs already live on device (the sampler token lanes), so the
+        pull is not on the dispatch critical path."""
         san = self.sanitizer
+        if self.spec is not None:
+            with (nullcontext() if san is None else san.decode_window()):
+                res = self._spec_round_body()
+            if res is not None:
+                self._deliver_spec(*res)
+            return
         with (nullcontext() if san is None else san.decode_window()):
             res = self._decode_body()
         if res is not None:
@@ -1520,10 +1648,184 @@ class Engine:
             if self._done_after_emit(req):
                 self._retire(req)
 
+    def _prepare_spec_paged(self) -> None:
+        """Host-side block maintenance before a speculative round: each
+        running slot must exclusively own the blocks covering its whole
+        verify window ``[len, len+k]`` (the fixed-shape verify writes
+        all k+1 positions regardless of acceptance) — fresh blocks
+        appended, shared covering blocks copied-on-extend, exactly the
+        per-position ``ensure_capacity`` contract the plain decode path
+        uses, applied across the window.  Over-the-end positions of a
+        near-capacity slot are excluded (the verify write masks them to
+        scratch).  A slot the pool cannot serve fails its request; the
+        engine and the rest of the batch continue."""
+        k = self.spec.k
+        for slot, req in list(self.running.items()):
+            ok = True
+            try:
+                last = min(req._seq_len + k, self.max_seq - 1)
+                for pos in range(req._seq_len, last + 1):
+                    if not self.cache.ensure_capacity(slot, pos):
+                        ok = False
+                        break
+            except Exception as e:       # noqa: BLE001 — accounting bug
+                self._mark_block_corruption(
+                    f"ensure_capacity({slot}) failed: "
+                    f"{type(e).__name__}: {e}")
+                ok = False
+            if not ok:
+                self.tracer.on_block_pressure(req, self.name,
+                                              kind="pool_exhausted",
+                                              position=req._seq_len)
+                self._retire(req, "failed",
+                             error="KV block pool exhausted: no block "
+                                   "free for the verify window at "
+                                   f"position {req._seq_len} (even "
+                                   "after prefix-cache eviction)")
+
+    # tpulint: hot-path
+    def _spec_round_body(self):
+        """Dispatch one speculative ROUND: k draft-decode steps (the
+        proposals chain through the draft sampler's device token lane)
+        and one bucketed ``[slots, k+1]`` verify step with in-graph
+        acceptance.  Device handles only — no d2h coercion belongs here
+        (tpulint TPL106; the sanitizer window covers this dispatch, so
+        the measured per-round host transfers stay 0.0).  Returns
+        ``(round_tensor, t0)`` or None (nothing ran / round failed)."""
+        spec = self.spec
+        if self.kv_layout == "paged":
+            self._prepare_spec_paged()
+        if not self.running:
+            return None
+        active = np.zeros((self.num_slots,), dtype=np.int32)
+        cap = np.ones((self.num_slots,), dtype=np.int32)
+        for slot, req in self.running.items():
+            active[slot] = 1
+            # per-slot emission cap: token budget and cache capacity,
+            # host ints only — the in-graph acceptance clamps to it
+            # (truncating the emission stream is distribution-safe:
+            # every emitted position is marginally the target law).
+            # Both terms are >= 1 for any request still running —
+            # _done_after_emit retires at the budget/capacity boundary
+            # before the next round — so the max(1, ...) is a floor for
+            # the in-graph clip's domain, never a behavior change.
+            cap[slot] = max(1, min(spec.k + 1,
+                                   req.max_new_tokens
+                                   - len(req.output_ids),
+                                   self.max_seq - req._seq_len))
+        t0 = time.perf_counter()
+        san = self.sanitizer
+        try:
+            with (nullcontext() if san is None else san.compiled_guard()):
+                act_t = to_tensor(active)
+                for j in range(spec.k):
+                    self._step_call("serving.spec_draft",
+                                    self._draft_decode_fn, act_t,
+                                    to_tensor(np.int32(j)))
+                out = self._step_call("serving.spec_verify",
+                                      self._verify_fn, act_t,
+                                      to_tensor(cap))
+        except Exception as e:           # noqa: BLE001 — isolated upstream
+            if san is not None and "device-to-host transfer" in str(e):
+                san.guard_violations += 1
+            msg = (f"speculative round failed after "
+                   f"{self.max_step_retries} "
+                   f"retr{'y' if self.max_step_retries == 1 else 'ies'}: "
+                   f"{type(e).__name__}: {e}")
+            for req in list(self.running.values()):
+                self._retire(req, "failed", error=msg, kind="replica")
+            return None
+        if san is not None:
+            san.note_step()             # one round == one counted step
+        return out, t0
+
+    def _deliver_spec(self, out, t0: float) -> None:
+        """Post-dispatch host half of a speculative round: pull the ONE
+        ``[slots, k+2]`` int32 round result (per-slot emitted count +
+        emission stream — the same shape-class pull as non-speculative
+        stream delivery, outside the sanitizer window and the hot-path
+        dispatch), then do the host bookkeeping the in-graph acceptance
+        cannot: paged block-table truncation past the accepted length,
+        journal/metrics/tracer records (one batched record per ROUND —
+        the decode_step discipline), stream callbacks, and retirement
+        checks."""
+        arr = out.numpy()                # [slots, k+2] int32
+        now = time.perf_counter()
+        spec = self.spec
+        running = list(self.running.items())
+        step_s = now - t0
+        delivered: Dict[int, List[int]] = {}
+        accepted_total = 0
+        for slot, req in running:
+            m = int(arr[slot, 0])
+            accepted_total += max(0, m - 1)
+            toks = [int(t) for t in arr[slot, 1:1 + m]]
+            if req.eos_token_id is not None and req.eos_token_id in toks:
+                # the round ran past the stop token; everything after
+                # it is never delivered (matching the non-speculative
+                # loop, which would have stopped there)
+                toks = toks[:toks.index(req.eos_token_id) + 1]
+            delivered[slot] = toks
+        if self.journal is not None:
+            # ONE batched record per ROUND, each jid carrying its whole
+            # delivered burst (journal BEFORE the user-visible emits:
+            # at-least-once delivery across a crash, unchanged)
+            tokmap = {r.journal_id: delivered[s]
+                      for s, r in running
+                      if r.journal_id is not None and delivered[s]}
+            if tokmap:
+                self.journal.record_tokens(self.name, self._step_counter,
+                                           tokmap)
+        self.metrics.on_spec_round(
+            step_s, draft_steps=spec.k,
+            proposed=spec.k * len(running), accepted=accepted_total,
+            delivered=[len(delivered[s]) for s, _ in running])
+        tr = self.tracer
+        if tr.enabled:
+            # ONE batched event per ROUND, never one per token — the
+            # decode_step discipline with the round's (proposed,
+            # accepted) pair riding along
+            tr.on_verify_step(self.name, self._step_counter,
+                              [s for s, _ in running], step_s,
+                              proposed=spec.k * len(running),
+                              accepted=accepted_total)
+        for slot, req in running:
+            m = int(arr[slot, 0])
+            req._seq_len += m            # the in-graph advance, mirrored
+            if self.kv_layout == "paged":
+                # rollback bookkeeping: drop table blocks past the
+                # accepted length (no copy — refcounts + table writes)
+                try:
+                    self.cache.truncate_blocks(slot, req._seq_len)
+                except Exception as e:   # noqa: BLE001 — accounting bug
+                    self._mark_block_corruption(
+                        f"truncate_blocks({slot}) failed: "
+                        f"{type(e).__name__}: {e}")
+            finished = False
+            for tok in delivered[slot]:
+                if not self._emit_token(req, tok, now):
+                    finished = True      # callback failure retired it
+                    break
+                if req.done:             # cancelled from inside its cb
+                    finished = True
+                    break
+                if len(req.output_ids) >= req.max_new_tokens or \
+                        (req.eos_token_id is not None
+                         and req.output_ids[-1] == req.eos_token_id):
+                    self._retire(req)
+                    finished = True
+                    break
+            if not finished and not req.done \
+                    and req._seq_len + 1 > self.max_seq:
+                # cache capacity: checked once per round (the cap
+                # already bounded the burst to fit)
+                self._retire(req)
+
     def step(self) -> bool:
         """One scheduler tick: reap cancellations/deadlines, admit queued
         requests into free slots, then run one decode step for all running
-        slots.  Returns True while there is in-flight or queued work.
+        slots (one speculative ROUND when speculation is on).  Returns
+        True while there is in-flight or queued work.
         Raises ``EngineStopped`` once the watchdog has marked the engine
         unhealthy."""
         if self.state == "unhealthy":

@@ -64,7 +64,7 @@ import os
 import time
 import zlib
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Union
 
 __all__ = ["RequestJournal", "JournalCorrupt"]
 
@@ -214,7 +214,11 @@ class RequestJournal:
             self._tokens[jid] = []
         elif kind == "tokens":
             for jid, tok in rec.get("toks", {}).items():
-                self._tokens.setdefault(jid, []).append(int(tok))
+                if isinstance(tok, list):        # speculative burst
+                    self._tokens.setdefault(jid, []).extend(
+                        int(x) for x in tok)
+                else:
+                    self._tokens.setdefault(jid, []).append(int(tok))
                 jids.append(jid)
         elif kind == "restart":
             jid = rec["jid"]
@@ -336,12 +340,18 @@ class RequestJournal:
         })
 
     def record_tokens(self, engine: str, step: int,
-                      toks: Dict[str, int]) -> None:
+                      toks: Dict[str, Union[int, Sequence[int]]]) -> None:
         """One BATCHED record per engine step: every slot's delivered
-        token keyed by journal id (never one record per token)."""
+        token keyed by journal id (never one record per token).  A
+        speculative round delivers a BURST per slot — the value may be
+        a list of ints (one record per round, the same batching
+        discipline; scan-side the burst appends in order)."""
         self._append({"kind": "tokens", "engine": engine,
                       "step": int(step),
-                      "toks": {j: int(t) for j, t in toks.items()}})
+                      "toks": {j: ([int(x) for x in t]
+                                   if isinstance(t, (list, tuple))
+                                   else int(t))
+                               for j, t in toks.items()}})
 
     def record_restart(self, jid: str, reason: str = "preempt") -> None:
         """The stream restarted from token 0 mid-engine (preemption):
@@ -415,6 +425,12 @@ class RequestJournal:
         if s.get("seed") is None:
             s["seed"] = rec["seed_effective"]
         return s
+
+    def tokens_for(self, jid: str) -> list:
+        """Tokens journaled for ``jid`` since its last admission or
+        restart record, in delivery order (speculative per-round bursts
+        flattened) — the delivery audit surface."""
+        return list(self._tokens.get(jid, []))
 
     def pending(self) -> "OrderedDict[str, dict]":
         """Non-terminal journaled requests — admission recorded, no

@@ -119,9 +119,47 @@ class KVCache:
             outs.append(Tensor._wrap(layer))
         return outs[0], outs[1], Tensor._wrap(lens)
 
+    def verify_write(self, layer_idx: int, k, v
+                     ) -> Tuple[Tensor, Tensor, Tensor]:
+        """Speculative verify write: W tokens per slot at that slot's
+        positions ``lengths[slot] .. lengths[slot] + W - 1``.
+
+        ``k``/``v``: ``[slots, W, Hkv, D]`` (W = k_draft + 1, a trace
+        constant).  Returns the post-write layer caches
+        ``[slots, max_seq, Hkv, D]`` and the window-start lengths
+        ``[slots]`` — what ``ops.verify_attention`` consumes.  Writes
+        past ``max_seq`` are scatter-dropped (a near-capacity slot's
+        over-the-end window positions are junk the acceptance cap
+        already makes unemittable — and unreadable, per the write
+        discipline)."""
+        lens = self.lengths._value()
+        W = k.shape[1]
+        rows = jnp.arange(self.num_slots, dtype=jnp.int32)[:, None]
+        pos = lens[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        outs = []
+        for buf, new in ((self.k, k), (self.v, v)):
+            arr = buf._value()
+            upd = new._value().astype(arr.dtype)        # [slots,W,Hkv,D]
+            layer = arr[:, layer_idx]                   # [slots,T,Hkv,D]
+            layer = layer.at[rows, pos].set(upd)        # OOB rows dropped
+            buf._set_data(arr.at[:, layer_idx].set(layer))
+            outs.append(Tensor._wrap(layer))
+        return outs[0], outs[1], Tensor._wrap(lens)
+
+    def verify_attention(self, layer_idx: int, q, k, v):
+        """One verify-window step of attention for this layer: write the
+        W-token window, then attend with the per-slot offset causal
+        mask (``ops.verify_attention``)."""
+        from ..ops.cached_attention import verify_attention
+
+        k_full, v_full, lens = self.verify_write(layer_idx, k, v)
+        return verify_attention(q, k_full, v_full, lens)
+
     def advance(self, active) -> None:
         """Grow lengths by one for active slots (call once per decode step,
-        after all layers have written)."""
+        after all layers have written).  Speculative rounds pass
+        ``active * accepted_count`` — the mask is added verbatim, so a
+        multi-token advance rides the same op."""
         mask = _as_i32(active)
         self.lengths._set_data(self.lengths._value() + mask)
 
@@ -148,22 +186,27 @@ class CacheContext:
 
     ``mode`` selects the path: ``"prefill"`` runs the normal causal forward
     while writing K/V into ``slot``; ``"decode"`` runs single-token cached
-    attention for all slots at once.  ``layer_idx`` is advanced by the
-    model's layer loop (a per-trace python constant).  Models only duck-type
-    this object, keeping ``models/`` free of serving imports.
+    attention for all slots at once; ``"verify"`` is the speculative-
+    decoding verify window — ``width`` tokens per slot at each slot's own
+    offset, one fixed-shape forward scoring every draft proposal at once
+    (``width`` = k_draft + 1, a trace-time python constant).
+    ``layer_idx`` is advanced by the model's layer loop (a per-trace
+    python constant).  Models only duck-type this object, keeping
+    ``models/`` free of serving imports.
     """
 
     cache: KVCache
-    mode: str                                   # "prefill" | "decode"
+    mode: str                           # "prefill" | "decode" | "verify"
     slot: Optional[Tensor] = None               # prefill: scalar int32
     length: Optional[Tensor] = None             # prefill: scalar int32
-    active: Optional[Tensor] = None             # decode: [slots] int32 mask
+    active: Optional[Tensor] = None     # decode/verify: [slots] i32 mask
     layer_idx: int = 0
+    width: int = 1                      # verify: tokens per slot (k+1)
 
     def __post_init__(self):
-        if self.mode not in ("prefill", "decode"):
+        if self.mode not in ("prefill", "decode", "verify"):
             raise ValueError(f"CacheContext mode {self.mode!r} "
-                             "(want 'prefill' or 'decode')")
+                             "(want 'prefill', 'decode' or 'verify')")
 
     def write_prefill(self, k, v) -> None:
         self.cache.prefill_write(self.layer_idx, self.slot, k, v)
@@ -177,7 +220,12 @@ class CacheContext:
         The contiguous layout writes + runs the masked one-row oracle;
         a cache that defines its own ``decode_attention`` (the paged
         pool's kernel-vs-reference routing) takes over the whole step —
-        models stay single-path either way."""
+        models stay single-path either way.  In ``verify`` mode the same
+        call site routes the W-token speculative window through the
+        cache's ``verify_attention`` instead, so models need no
+        speculation-specific branch at all."""
+        if self.mode == "verify":
+            return self.cache.verify_attention(self.layer_idx, q, k, v)
         cache_fn = getattr(self.cache, "decode_attention", None)
         if cache_fn is not None:
             return cache_fn(self.layer_idx, q, k, v)
@@ -187,9 +235,16 @@ class CacheContext:
         return cached_attention(q, k_full, v_full, lens)
 
     def positions(self) -> Tensor:
-        """Current token positions ``[slots, 1]`` (pre-advance lengths) —
-        position ids for learned embeddings / rotary offsets in decode."""
-        return Tensor._wrap(self.cache.lengths._value()[:, None])
+        """Current token positions (pre-advance lengths) — position ids
+        for learned embeddings / rotary offsets.  Decode: ``[slots, 1]``;
+        verify: ``[slots, width]`` (each slot's window sits at its own
+        offset ``lengths[slot] .. lengths[slot] + width - 1``)."""
+        lens = self.cache.lengths._value()
+        if self.mode == "verify":
+            return Tensor._wrap(
+                lens[:, None]
+                + jnp.arange(self.width, dtype=jnp.int32)[None, :])
+        return Tensor._wrap(lens[:, None])
 
     # -- prefill routing hooks (overridden by serving.PagedCacheContext) --
 

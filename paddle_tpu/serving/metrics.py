@@ -81,6 +81,16 @@ class ServingMetrics:
         self.step_failures = 0
         self.step_retries = 0
         self.retries_by_point: Dict[str, int] = {}
+        # speculative decoding (ISSUE 15): per-round proposal/acceptance
+        # counters — the multiplicative-win observability (accept rate ×
+        # (k+1) bounds the target-step savings); spec_cb (set by the
+        # engine when speculation is on) contributes the config half
+        self.spec_rounds = 0
+        self.spec_draft_steps = 0
+        self.spec_verify_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_cb = None
         # engine-provided liveness snapshot (set by serving.Engine)
         self.health_cb = None
         # paged-KV observability (set by serving.Engine in paged mode):
@@ -132,6 +142,30 @@ class ServingMetrics:
         self.decode_time_s += step_s
         # per-token latency for each active stream is the step latency
         self.itl_s.extend([step_s] * n_active)
+
+    def on_spec_round(self, step_s: float, *, draft_steps: int,
+                      proposed: int, accepted: int,
+                      delivered) -> None:
+        """One speculative round: ``draft_steps`` draft dispatches + one
+        verify dispatch emitted ``delivered[i]`` tokens per active slot
+        (``accepted`` of the ``proposed`` draft tokens survived
+        verification; emitted = accepted + one bonus/resample each,
+        minus any stop-token truncation).  Folds into the same decode
+        token/time counters as plain decode steps so
+        ``decode_tokens_per_sec`` and the ITL window stay comparable
+        across modes (a burst of n tokens in one round prices each at
+        step_s / n)."""
+        self.spec_rounds += 1
+        self.spec_draft_steps += int(draft_steps)
+        self.spec_verify_steps += 1
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
+        self.decode_steps += 1
+        self.decode_time_s += step_s
+        for n in delivered:
+            if n > 0:
+                self.decode_tokens += n
+                self.itl_s.extend([step_s / n] * n)
 
     def on_complete(self) -> None:
         self.requests_completed += 1
@@ -244,6 +278,27 @@ class ServingMetrics:
         out["prefix_register_errors"] = self.prefix_register_errors
         return out
 
+    def _speculation_section(self):
+        """Speculative-decoding counters (None when speculation is off —
+        the snapshot shape says which mode served the traffic)."""
+        if self.spec_cb is None:
+            return None
+        out = dict(self.spec_cb())
+        out.update({
+            "rounds": self.spec_rounds,
+            "draft_steps": self.spec_draft_steps,
+            "verify_steps": self.spec_verify_steps,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "accept_rate": round(
+                self.spec_accepted / self.spec_proposed, 4)
+            if self.spec_proposed else 0.0,
+            "mean_accepted_per_round": round(
+                self.spec_accepted / self.spec_rounds, 4)
+            if self.spec_rounds else 0.0,
+        })
+        return out
+
     def occupancy(self) -> float:
         """Mean busy-slot fraction over all samples so far (0.0 before
         the first step) — shared by ``snapshot()`` and the fleet
@@ -292,6 +347,7 @@ class ServingMetrics:
                 "model_version": self.model_version,
             },
             "paging": self._paging_section(),
+            "speculation": self._speculation_section(),
             "queue_depth": self.queue_depth,
             "queue_depth_max": self.queue_depth_max,
             "slot_occupancy": round(occ, 4),

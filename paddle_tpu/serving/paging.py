@@ -41,7 +41,7 @@ from ..core.tensor import Tensor
 from ..core import dtype as dtype_mod
 from ..ops.cached_attention import (
     block_prefill_attention, cached_attention, gather_block_kv,
-    paged_decode_attention, paged_prefill_attention,
+    paged_decode_attention, paged_prefill_attention, verify_attention,
 )
 from .kv_cache import CacheContext, _as_i32
 
@@ -361,6 +361,28 @@ class PagedKVCache:
             self.copy_on_extends += 1
         return True
 
+    def truncate_blocks(self, slot: int, n_tokens: int) -> int:
+        """Speculative rollback bookkeeping: drop the slot's owned
+        blocks past the ones covering positions ``0..n_tokens-1`` (the
+        rejected tail of a verify window — no copy, just refcount +
+        table writes; the rejected K/V bytes become unreadable the
+        moment the in-graph length rollback lands).  Returns how many
+        blocks were released."""
+        owned = self._slot_blocks[slot]
+        keep = (int(n_tokens) + self.block_size - 1) // self.block_size
+        if len(owned) <= keep:
+            return 0
+        drop = owned[keep:]
+        del owned[keep:]
+        tbl = self.block_tables._value()
+        row = jnp.asarray(
+            [SCRATCH_BLOCK] * self.max_blocks_per_slot, dtype=jnp.int32)
+        row = row.at[:len(owned)].set(jnp.asarray(owned, dtype=jnp.int32))
+        self.block_tables._set_data(tbl.at[slot].set(row))
+        for b in drop:
+            self.allocator.unref(b)
+        return len(drop)
+
     def reset(self) -> None:
         """Forget all sequences: release every slot and zero lengths.
         Cached (prefix) blocks are left to their owner — the engine
@@ -452,6 +474,50 @@ class PagedKVCache:
                 interpret=self._interpret)
         k_full, v_full, lens = self.decode_write(layer_idx, k, v)
         return cached_attention(q, k_full, v_full, lens)
+
+    def verify_write(self, layer_idx: int, k, v):
+        """Speculative verify write through the block table: W tokens
+        per slot at positions ``lengths[slot] .. lengths[slot]+W-1``.
+        Block ids stay tensor VALUES (one executable for every table
+        content); positions past ``max_seq`` are redirected to the
+        scratch block, so a near-capacity slot's over-the-end window
+        writes land on storage nothing ever reads.  The caller must
+        have pre-extended each running slot's table to cover the
+        in-range window (``ensure_capacity`` per position — exclusive
+        ownership via copy-on-extend included).  Returns
+        ``(k_layer, v_layer, tables, lengths)`` raw arrays."""
+        lens = self.lengths._value()
+        bs = self.block_size
+        tbl = self.block_tables._value()            # [slots, max_blocks]
+        W = int(k.shape[1])
+        pos = lens[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        bidx = jnp.clip(pos // bs, 0, self.max_blocks_per_slot - 1)
+        block_ids = jnp.take_along_axis(tbl, bidx, axis=1)   # [slots, W]
+        block_ids = jnp.where(pos < self.max_seq, block_ids,
+                              SCRATCH_BLOCK)
+        off = pos % bs
+        layers = []
+        for buf, new in ((self.k, k), (self.v, v)):
+            arr = buf._value()
+            upd = new._value().astype(arr.dtype)    # [slots, W, Hkv, D]
+            arr = arr.at[block_ids, layer_idx, off].set(upd)
+            buf._set_data(arr)
+            layers.append(arr[:, layer_idx])
+        return layers[0], layers[1], tbl, lens
+
+    def verify_attention(self, layer_idx: int, q, k, v):
+        """One verify-window step for this layer: write the W-token
+        window through the table, gather the slot sequences contiguous,
+        and attend with the per-slot offset causal mask.  The verify
+        path always uses the XLA gather + ``ops.verify_attention``
+        oracle (the Pallas decode/prefill kernels are W-specific and
+        stay on their own paths) — semantics identical either way, and
+        kernel selection still never changes a compiled shape."""
+        k_layer, v_layer, tbl, lens = self.verify_write(layer_idx, k, v)
+        return verify_attention(
+            q, Tensor._wrap(gather_block_kv(k_layer, tbl)),
+            Tensor._wrap(gather_block_kv(v_layer, tbl)),
+            Tensor._wrap(lens))
 
     def advance(self, active) -> None:
         mask = _as_i32(active)

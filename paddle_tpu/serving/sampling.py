@@ -9,6 +9,15 @@ the next step's inputs device-side — no per-token logits pull, which is
 what drives the sanitizer's ``serving_decode_host_transfers`` baseline
 from 1.0 to 0.0 (ROADMAP item 2).
 
+Speculative decoding rides the same lanes:
+:meth:`DeviceSampler.accept_speculative` performs a whole round's
+rejection-sampling acceptance in-graph (greedy: accept iff draft ==
+target argmax, emit the argmax on rejection — bitwise-equal to plain
+decoding; sampling: accept with ``min(1, p_t/p_d)``, resample the
+normalized residual — marginally the target law at every position),
+advancing the key lanes once per round and syncing both the target and
+draft token lanes to the new pending token.
+
 :func:`sample` is retained as the **host reference implementation** — the
 parity oracle the on-device path is tested against (greedy must match
 bitwise; seeded top-k/top-p statistically).  It is dtype-explicit:
@@ -253,3 +262,114 @@ class DeviceSampler:
         self.keys._set_data(new_keys)
         self.tokens._set_data(toks)
         return toks
+
+    def _masked_probs(self, logits):
+        """Per-slot-masked sampling distributions for a ``[S, W, V]``
+        verify window: each slot's temperature/top-k/top-p lanes applied
+        to every window position (softmax of the masked, tempered
+        logits — exactly the distribution :func:`device_sample` draws
+        from, so acceptance ratios price the real proposal/target
+        laws)."""
+        S, W, V = logits.shape
+        temps = jnp.repeat(jnp.where(self.temps._value() <= 0.0, 1.0,
+                                     self.temps._value()), W)
+        z = _device_masked_logits(
+            logits.reshape(S * W, V).astype(jnp.float32), temps,
+            jnp.repeat(self.top_ks._value(), W),
+            jnp.repeat(self.top_ps._value(), W))
+        return jax.nn.softmax(z, axis=-1).reshape(S, W, V)
+
+    def accept_speculative(self, target_logits, draft_logits,
+                           draft_tokens, cap, draft_sampler
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Rejection-sampling acceptance of one speculative round,
+        entirely in-graph (traced inside the compiled verify step — the
+        zero-host-transfer decode invariant extends to speculation).
+
+        Args:
+            target_logits: ``[S, W, V]`` target-model logits over the
+                verify window (position ``i`` scores the token AFTER
+                input ``i``; W = k + 1).
+            draft_logits:  ``[S, W, V]`` draft-model logits over the
+                same window (recomputed in the verify step, so the
+                acceptance ratio uses exactly the law the proposals
+                were drawn from — and the draft KV for the window is
+                complete even on full acceptance).
+            draft_tokens:  ``[S, k]`` the round's draft proposals.
+            cap:           ``[S]`` int32 — per-slot emission cap
+                (token budget / cache capacity, computed host-side);
+                the emission stream is truncated to it, which is
+                distribution-preserving (every emitted position is
+                marginally the target law).
+            draft_sampler: the draft model's :class:`DeviceSampler`
+                (its param lanes define the proposal distribution; its
+                token lane is synced to the new pending token so the
+                next round's first draft step feeds device-side).
+
+        Returns:
+            ``(emitted [S, W] int32, m [S] int32)`` — ``emitted[:m]``
+            is the round's delivered stream (accepted draft prefix plus
+            one bonus/resample token, ``1 <= m <= min(W, cap)``);
+            entries past ``m`` are junk the host never reads.
+
+        Greedy slots (temperature 0) accept a draft token iff it equals
+        the target argmax and emit the target argmax on rejection — so
+        every emitted token IS the target argmax and greedy speculative
+        output is bitwise identical to non-speculative decoding.
+        Sampling slots follow standard speculative rejection sampling
+        (accept with ``min(1, p_t/p_d)``, resample the normalized
+        residual ``max(p_t - p_d, 0)`` on rejection, plain target draw
+        for the bonus position) — marginally the target distribution at
+        every position.  Key lanes advance once per round; re-seeding a
+        slot replays the identical round stream (the preempt-resume /
+        crash-recovery determinism contract)."""
+        S, W, V = target_logits.shape
+        k = W - 1
+        greedy = self.temps._value() <= 0.0                   # [S]
+        pt = self._masked_probs(target_logits)                # [S, W, V]
+        pd = draft_sampler._masked_probs(draft_logits)        # [S, W, V]
+        # position k carries no proposal: zero its draft mass so the
+        # "residual" there is the plain target distribution (the bonus
+        # draw) — one formula covers reject-resample AND bonus
+        pd = pd.at[:, k, :].set(0.0)
+        g = jnp.argmax(target_logits.astype(jnp.float32),
+                       axis=-1).astype(jnp.int32)             # [S, W]
+        # accept test per draft position
+        pt_d = jnp.take_along_axis(
+            pt[:, :k, :], draft_tokens[..., None], axis=2)[..., 0]
+        pd_d = jnp.take_along_axis(
+            pd[:, :k, :], draft_tokens[..., None], axis=2)[..., 0]
+        keys = self.keys._value()
+        split = jax.vmap(lambda kk: jax.random.split(kk, 2 + W))(keys)
+        new_keys, ukeys, ckeys = split[:, 0], split[:, 1], split[:, 2:]
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(ukeys)
+        ratio = pt_d / jnp.maximum(pd_d, jnp.float32(1e-30))
+        accept = jnp.where(greedy[:, None],
+                           draft_tokens == g[:, :k],
+                           u < jnp.minimum(ratio, 1.0))       # [S, k]
+        n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                        axis=1)                               # [S]
+        # replacement token per position: residual resample (sampling)
+        # or target argmax (greedy); identical target/draft laws leave
+        # an all-zero residual — fall back to the target law itself
+        res = jnp.maximum(pt - pd, 0.0)
+        res = jnp.where(
+            (jnp.sum(res, axis=-1) <= 0.0)[..., None], pt, res)
+        rep = jax.vmap(jax.vmap(jax.random.categorical))(
+            ckeys, jnp.log(res)).astype(jnp.int32)            # [S, W]
+        rep = jnp.where(greedy[:, None], g, rep)
+        # emission stream: accepted draft prefix, then the replacement
+        d_pad = jnp.concatenate(
+            [draft_tokens.astype(jnp.int32),
+             jnp.zeros((S, 1), dtype=jnp.int32)], axis=1)
+        idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+        emitted = jnp.where(idx < n_acc[:, None], d_pad, rep)
+        m = jnp.clip(n_acc.astype(jnp.int32) + 1, 1,
+                     jnp.maximum(cap.astype(jnp.int32), 1))
+        pend = jnp.take_along_axis(emitted, (m - 1)[:, None],
+                                   axis=1)[:, 0]
+        self.keys._set_data(new_keys)
+        self.tokens._set_data(pend)
+        # the draft chains off the same pending token next round
+        draft_sampler.tokens._set_data(pend)
+        return emitted, m

@@ -272,6 +272,20 @@ class RequestTracer:
                     slots=list(slots), n_active=len(slots),
                     dt_ms=round(dt_s * 1e3, 3))
 
+    def on_verify_step(self, replica: str, step: int, slots,
+                       dt_s: float, *, proposed: int,
+                       accepted: int) -> None:
+        """The speculative variant of :meth:`on_decode_step`: ONE event
+        per engine ROUND (k draft steps + one verify step, never one
+        per token or per draft step), carrying the round's (proposed,
+        accepted) draft-token pair — the acceptance story per round,
+        rendered by the Perfetto exporter as an ``accepted_tokens``
+        counter track next to ``active_slots``."""
+        self._event("verify_step", replica=replica, step=step,
+                    slots=list(slots), n_active=len(slots),
+                    dt_ms=round(dt_s * 1e3, 3),
+                    proposed=int(proposed), accepted=int(accepted))
+
     def on_retired(self, req, replica: str, state: str,
                    error: Optional[str] = None) -> None:
         """Terminal (engine-level) transition.  Final for the trace

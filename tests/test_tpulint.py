@@ -293,8 +293,13 @@ class TestShapeManifest:
         assert committed["digest"] == fresh["digest"]
 
     def test_key_space_is_buckets_plus_one_per_layout(self, fresh):
+        # plain layouts: one prefill per bucket + ONE decode; the
+        # speculative layout replaces decode with one draft prefill per
+        # bucket + ONE draft decode + ONE verify (ISSUE 15)
         for layout, sec in fresh["configs"].items():
-            assert sec["programs"] == len(sec["buckets"]) + 1, layout
+            nb = len(sec["buckets"])
+            want = 2 * nb + 2 if layout == "speculative" else nb + 1
+            assert sec["programs"] == want, layout
             assert sec["closure_probe"]["escapes"] == 0
 
     def test_entries_are_fully_specified(self, fresh):

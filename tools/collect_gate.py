@@ -155,6 +155,8 @@ TIER1_CRITICAL = {
         "training step observatory (timeline/compile/cost ledgers)",
     "tests/test_durability.py":
         "request journal, crash recovery & rolling weight hot-swap",
+    "tests/test_spec_decode.py":
+        "speculative decoding: draft/verify/accept parity & rollback",
 }
 
 

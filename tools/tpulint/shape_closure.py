@@ -56,6 +56,12 @@ CANONICAL = {
     "min_bucket": 8,
     "block_size": 8,        # paged layout only
     "fleet_replicas": 2,    # bench fleet smoke: 2 replicas
+    # speculative section (ISSUE 15): the opt-in draft/verify key set —
+    # a paged engine with speculation on replaces the decode key with
+    # draft_prefill[b=*] + draft_decode + verify (the proposal column
+    # index and the per-slot emission caps are argument VALUES)
+    "spec_draft": "gpt:tiny",
+    "spec_k": 4,
 }
 
 
@@ -114,12 +120,15 @@ def _out_shapes(prog) -> List[List]:
 
 
 def _build_engine(kv_layout: str, cfg: dict):
-    from paddle_tpu.serving import Engine
+    from paddle_tpu.serving import Engine, SpecConfig
 
     kwargs = dict(num_slots=cfg["num_slots"], max_seq=cfg["max_seq"],
                   min_bucket=cfg["min_bucket"])
-    if kv_layout == "paged":
+    if kv_layout in ("paged", "speculative"):
         kwargs.update(kv_layout="paged", block_size=cfg["block_size"])
+    if kv_layout == "speculative":
+        kwargs.update(speculation=SpecConfig(
+            draft_model=cfg["spec_draft"], k=cfg["spec_k"]))
     eng = Engine(Engine.resolve_model(cfg["model"]), **kwargs)
     eng._build_steps()
     return eng
@@ -152,6 +161,36 @@ def _decode_args(eng, *, n_active: int = 0):
     return [to_tensor(active)]
 
 
+def _draft_prefill_args(eng, bucket: int, *, L: int = 1, slot: int = 0):
+    """Draft prefill is always full-prompt + contiguous (no prefix
+    cache, no ``start``), whatever the target layout."""
+    import numpy as np
+    from paddle_tpu.core.tensor import to_tensor
+
+    ids = np.zeros((1, bucket), dtype=np.int64)
+    return [to_tensor(ids), to_tensor(np.int32(slot)),
+            to_tensor(np.int32(L))]
+
+
+def _draft_decode_args(eng, *, n_active: int = 0, j: int = 0):
+    """Draft decode adds only the proposal COLUMN index ``j`` (a traced
+    scalar — k sequential calls per round share one compiled key)."""
+    import numpy as np
+    from paddle_tpu.core.tensor import to_tensor
+
+    return _decode_args(eng, n_active=n_active) + [to_tensor(np.int32(j))]
+
+
+def _verify_args(eng, *, n_active: int = 0, cap: int = 1):
+    """Verify adds only the per-slot emission caps (values, not
+    shapes): ``[slots] int32`` like the active mask."""
+    import numpy as np
+    from paddle_tpu.core.tensor import to_tensor
+
+    caps = np.full((eng.num_slots,), cap, dtype=np.int32)
+    return _decode_args(eng, n_active=n_active) + [to_tensor(caps)]
+
+
 def enumerate_config(kv_layout: str, cfg: dict) -> Tuple[dict, dict]:
     """Build every program the config admits; returns
     ``(manifest_section, key_index)`` where ``key_index`` maps each raw
@@ -164,7 +203,18 @@ def enumerate_config(kv_layout: str, cfg: dict) -> Tuple[dict, dict]:
     with no_grad():
         plan = [(f"prefill[b={b}]", eng._prefill_fn, _prefill_args(eng, b))
                 for b in eng.buckets]
-        plan.append(("decode", eng._decode_fn, _decode_args(eng)))
+        if eng.spec is None:
+            plan.append(("decode", eng._decode_fn, _decode_args(eng)))
+        else:
+            # speculation replaces the plain decode program: draft
+            # prefill per bucket (contiguous draft cache — no start
+            # argument), ONE draft decode, ONE verify
+            plan.extend(
+                (f"draft_prefill[b={b}]", eng._draft_prefill_fn,
+                 _draft_prefill_args(eng, b)) for b in eng.buckets)
+            plan.append(("draft_decode", eng._draft_decode_fn,
+                         _draft_decode_args(eng)))
+            plan.append(("verify", eng._verify_fn, _verify_args(eng)))
         for name, fn, args in plan:
             key = _cache_key(fn, args)
             prog = fn.get_concrete_program(*args)
@@ -177,8 +227,10 @@ def enumerate_config(kv_layout: str, cfg: dict) -> Tuple[dict, dict]:
                 "key_sha256": _sha(key),
             }
             key_index[key] = name
-    n_prog = (len(eng._prefill_fn.program_cache)
-              + len(eng._decode_fn.program_cache))
+    fns = [eng._prefill_fn]
+    fns += [eng._decode_fn] if eng.spec is None else \
+        [eng._draft_prefill_fn, eng._draft_decode_fn, eng._verify_fn]
+    n_prog = sum(len(fn.program_cache) for fn in fns)
     if n_prog != len(entries):
         raise AssertionError(
             f"{kv_layout}: enumerated {len(entries)} entries but the "
@@ -189,7 +241,10 @@ def enumerate_config(kv_layout: str, cfg: dict) -> Tuple[dict, dict]:
                    "max_seq": cfg["max_seq"],
                    "min_bucket": cfg["min_bucket"],
                    **({"block_size": cfg["block_size"]}
-                      if kv_layout == "paged" else {})},
+                      if kv_layout in ("paged", "speculative") else {}),
+                   **({"spec_draft": cfg["spec_draft"],
+                       "spec_k": cfg["spec_k"]}
+                      if kv_layout == "speculative" else {})},
         "buckets": list(eng.buckets),
         "programs": len(entries),
         "entries": entries,
@@ -226,12 +281,40 @@ def probe_closure(eng, key_index: Dict[tuple, str]) -> List[str]:
                         escapes.append(
                             f"prefill L={L} slot={slot} start={start} "
                             f"-> unenumerated key {_sha(key)}")
-        for n_active in range(eng.num_slots + 1):
-            key = _cache_key(eng._decode_fn, _decode_args(
-                eng, n_active=n_active))
-            if key not in key_index:
-                escapes.append(f"decode n_active={n_active} -> "
-                               f"unenumerated key {_sha(key)}")
+        if eng.spec is None:
+            for n_active in range(eng.num_slots + 1):
+                key = _cache_key(eng._decode_fn, _decode_args(
+                    eng, n_active=n_active))
+                if key not in key_index:
+                    escapes.append(f"decode n_active={n_active} -> "
+                                   f"unenumerated key {_sha(key)}")
+        else:
+            for L in range(1, eng.max_seq + 1):
+                for slot in (0, eng.num_slots - 1):
+                    bucket = eng.bucket_for(L)
+                    key = _cache_key(
+                        eng._draft_prefill_fn,
+                        _draft_prefill_args(eng, bucket, L=L, slot=slot))
+                    if key not in key_index:
+                        escapes.append(
+                            f"draft_prefill L={L} slot={slot} -> "
+                            f"unenumerated key {_sha(key)}")
+            for n_active in range(eng.num_slots + 1):
+                for j in range(eng.spec.k):
+                    key = _cache_key(eng._draft_decode_fn,
+                                     _draft_decode_args(
+                                         eng, n_active=n_active, j=j))
+                    if key not in key_index:
+                        escapes.append(
+                            f"draft_decode n_active={n_active} j={j} "
+                            f"-> unenumerated key {_sha(key)}")
+                for cap in (1, eng.spec.k + 1):
+                    key = _cache_key(eng._verify_fn, _verify_args(
+                        eng, n_active=n_active, cap=cap))
+                    if key not in key_index:
+                        escapes.append(
+                            f"verify n_active={n_active} cap={cap} -> "
+                            f"unenumerated key {_sha(key)}")
     return escapes
 
 
@@ -239,7 +322,7 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
     """Enumerate + probe both KV layouts; raises on any closure escape
     (an open key space must never be written as a 'proof')."""
     configs = {}
-    for layout in ("contiguous", "paged"):
+    for layout in ("contiguous", "paged", "speculative"):
         section, (eng, key_index) = enumerate_config(layout, cfg)
         escapes = probe_closure(eng, key_index)
         if escapes:
@@ -249,13 +332,21 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
         section["closure_probe"] = {
             "prefill_instances": 2 * sum(
                 len(range(0, L, eng.block_size))
-                if layout == "paged" else 1
+                if layout in ("paged", "speculative") else 1
                 for L in range(1, eng.max_seq + 1)),
-            "decode_instances": eng.num_slots + 1,
+            "decode_instances": (
+                eng.num_slots + 1 if eng.spec is None
+                # draft_prefill sweep + draft_decode (j) + verify (cap)
+                else 2 * eng.max_seq
+                + (eng.num_slots + 1) * (eng.spec.k + 2)),
             "escapes": 0,
         }
         configs[layout] = section
-    per_replica = {k: v["programs"] for k, v in configs.items()}
+    # fleet replicas serve the plain layouts (speculation is a per-
+    # engine opt-in, not a fleet default): the multiplication note
+    # covers contiguous + paged only
+    per_replica = {k: v["programs"] for k, v in configs.items()
+                   if k != "speculative"}
     manifest = {
         "_comment": [
             "Shape-closure proof for the serving engine's executable",
