@@ -35,6 +35,10 @@ WHITE_LIST: Set[str] = {
     # embedding output sets the residual stream's dtype: bf16 keeps the
     # whole transformer block (LN included, see below) in bf16
     "embedding",
+    # chunked TP-overlap forwards must cast like their GSPMD twins
+    # (linear / embedding) so chunks>1 stays AMP-transparent
+    "tp_overlap_column_linear", "tp_overlap_row_linear",
+    "tp_overlap_vocab_embedding",
 }
 
 # numerically sensitive ops: force f32 (reference: BLACK_LIST —
@@ -44,6 +48,7 @@ WHITE_LIST: Set[str] = {
 # force a full-f32 residual stream and cast traffic around every matmul.
 BLACK_LIST: Set[str] = {
     "softmax", "log_softmax", "cross_entropy", "parallel_cross_entropy",
+    "tp_overlap_cross_entropy",
     "bce_with_logits", "binary_cross_entropy", "nll_loss", "kl_div",
     "ctc_loss",
     "mean", "sum", "var", "std",

@@ -11,8 +11,8 @@ from .mesh import (
 )
 from .collective import (
     ReduceOp, Group, new_group, get_group,
-    all_reduce, all_gather, broadcast, reduce, scatter, alltoall,
-    reduce_scatter, barrier, send, recv, ppermute,
+    all_reduce, all_reduce_chunked, all_gather, broadcast, reduce, scatter,
+    alltoall, reduce_scatter, barrier, send, recv, ppermute,
 )
 from .parallel import init_parallel_env, DataParallel
 from .strategy import DistributedStrategy

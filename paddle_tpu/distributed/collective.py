@@ -161,6 +161,33 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor
 
 
+def all_reduce_chunked(tensor: Tensor, chunks: int = 1, op=ReduceOp.SUM,
+                       group=None):
+    """All-reduce issued as ``chunks`` independent slice reductions along
+    the trailing axis — the collective-decomposition primitive behind the
+    TP overlap schedule (fleet/meta_parallel/overlap.py) exposed at the
+    collective API: XLA can interleave surrounding compute with the
+    per-chunk reduces instead of stalling on one monolithic fused
+    all-reduce.  ``chunks=1`` (or a non-dividing chunk count) is exactly
+    :func:`all_reduce`."""
+    g = _group_or_world(group)
+    arr = tensor._value()
+    _check_stacked(arr, g, "all_reduce_chunked")
+    last = arr.shape[-1] if arr.ndim > 1 else 1
+    if chunks <= 1 or last % chunks != 0:
+        return all_reduce(tensor, op=op, group=g)
+    red = _make_reducer(op, g)
+    ch = last // chunks
+
+    def body(s):
+        parts = [red(s[..., c * ch:(c + 1) * ch]) for c in range(chunks)]
+        return jnp.concatenate(parts, axis=-1)
+
+    out = _run("all_reduce_chunked", _smap(g, body), [tensor])
+    tensor._set_data(out._value())
+    return tensor
+
+
 def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True):
     """all_gather(tensor, group) -> stacked [W, W, ...]; or the reference
     list form all_gather(tensor_list, tensor) (collective.py:840)."""

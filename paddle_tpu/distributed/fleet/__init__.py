@@ -93,7 +93,12 @@ def distributed_model(model):
     zero3 = (_user_strategy is not None
              and _user_strategy.sharding_configs.stage >= 3
              and hcg.get_sharding_parallel_world_size() > 1)
-    wrapper = TensorParallel(model, hcg, seq_dim=seq_dim)
+    tp_cfg = getattr(_user_strategy, "tensor_parallel_configs", None) \
+        if _user_strategy is not None else None
+    tp_overlap = getattr(tp_cfg, "overlap_chunks", 1)
+    wrapper = TensorParallel(
+        model, hcg, seq_dim=seq_dim,
+        tp_overlap=tp_overlap if tp_overlap and tp_overlap > 1 else None)
     if zero3:
         place_parameters(model, hcg.mesh, zero_params=True)
     return wrapper

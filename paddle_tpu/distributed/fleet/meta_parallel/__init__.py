@@ -6,4 +6,7 @@ from .parallel_layers.pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
 from .parallel_layers.random import (
     RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
 )
+from .overlap import (
+    TPOverlapConfig, apply_tp_overlap, set_tp_overlap, get_tp_overlap,
+)
 from .tensor_parallel import TensorParallel, ShardingParallel

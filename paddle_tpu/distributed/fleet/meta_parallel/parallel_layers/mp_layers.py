@@ -13,6 +13,14 @@ ordinary ops, and constrains its output sharding; XLA's partitioner
 materializes exactly the Megatron comm pattern (identity fwd / psum bwd for
 column, psum fwd for row) — fused into the matmuls and riding ICI.
 Degenerates to plain layers when no mesh/model axis is active.
+
+Compute/collective overlap: with ``overlap_chunks > 1`` (per-layer
+kwarg, ``meta_parallel.overlap.apply_tp_overlap``, or a process-wide
+``set_tp_overlap``) the forward routes through the chunked-decomposition
+shard_map path in :mod:`..overlap`, which interleaves per-chunk
+collectives with the dots they hide behind (T3, arXiv 2401.16677).  At
+``chunks<=1`` — the default — the GSPMD path below runs untouched, so
+the baseline schedule is bitwise reproduced.
 """
 from __future__ import annotations
 
@@ -28,6 +36,7 @@ from .....ops import math as math_ops
 from ....sharding_spec import (
     MODEL_AXIS, batch_spec, mark_sharding, set_param_spec,
 )
+from .. import overlap as tp_overlap
 
 
 class VocabParallelEmbedding(Layer):
@@ -35,17 +44,26 @@ class VocabParallelEmbedding(Layer):
     (reference: mp_layers.py:30 — per-rank vocab range + allreduce; here the
     gather is partitioned by XLA)."""
 
+    _tp_overlap_capable = True
+
     def __init__(self, num_embeddings: int, embedding_dim: int,
-                 weight_attr=None, mp_group=None, name=None):
+                 weight_attr=None, mp_group=None, name=None,
+                 overlap_chunks: int = 1):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        self._tp_overlap_chunks = int(overlap_chunks)
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.XavierUniform())
         set_param_spec(self.weight, P(MODEL_AXIS, None))
 
     def forward(self, x):
+        chunks = tp_overlap.effective_chunks(self._tp_overlap_chunks)
+        if chunks > 1:
+            out = tp_overlap.vocab_parallel_embedding(x, self.weight, chunks)
+            if out is not None:
+                return out
         out = F.embedding(x, self.weight)
         return mark_sharding(out, batch_spec(x.ndim + 1, last=None))
 
@@ -55,13 +73,17 @@ class ColumnParallelLinear(Layer):
     (reference: mp_layers.py:95).  `gather_output=False` keeps the
     activation model-sharded for a following RowParallelLinear."""
 
+    _tp_overlap_capable = True
+
     def __init__(self, in_features: int, out_features: int, weight_attr=None,
                  has_bias: bool = True, gather_output: bool = True,
-                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None,
+                 overlap_chunks: int = 1):
         super().__init__()
         self._in_features = in_features
         self._out_features = out_features
         self.gather_output = gather_output
+        self._tp_overlap_chunks = int(overlap_chunks)
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
             default_initializer=I.XavierUniform())
@@ -73,6 +95,12 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        chunks = tp_overlap.effective_chunks(self._tp_overlap_chunks)
+        if chunks > 1:
+            out = tp_overlap.column_parallel_linear(
+                x, self.weight, self.bias, chunks, self.gather_output)
+            if out is not None:
+                return out
         out = F.linear(x, self.weight, self.bias)
         last = None if self.gather_output else MODEL_AXIS
         return mark_sharding(out, batch_spec(out.ndim, last=last))
@@ -83,13 +111,17 @@ class RowParallelLinear(Layer):
     psum of partial products (reference: mp_layers.py:171 — `_mp_allreduce`
     forward; here XLA inserts the reduce)."""
 
+    _tp_overlap_capable = True
+
     def __init__(self, in_features: int, out_features: int, weight_attr=None,
                  has_bias: bool = True, input_is_parallel: bool = False,
-                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None,
+                 overlap_chunks: int = 1):
         super().__init__()
         self._in_features = in_features
         self._out_features = out_features
         self.input_is_parallel = input_is_parallel
+        self._tp_overlap_chunks = int(overlap_chunks)
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
             default_initializer=I.XavierUniform())
@@ -101,6 +133,14 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        chunks = tp_overlap.effective_chunks(self._tp_overlap_chunks)
+        if chunks > 1:
+            # the shard_map in_spec model-shards x's last dim, which is
+            # the same constraint the mark_sharding below applies
+            out = tp_overlap.row_parallel_linear(
+                x, self.weight, self.bias, chunks)
+            if out is not None:
+                return out
         if not self.input_is_parallel:
             x = mark_sharding(x, batch_spec(x.ndim, last=MODEL_AXIS))
         out = F.linear(x, self.weight, self.bias)
@@ -112,11 +152,21 @@ class ParallelCrossEntropy(Layer):
     mp_layers.py:251 → c_softmax_with_cross_entropy op; here the
     logsumexp reduction is partitioned by XLA)."""
 
-    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+    _tp_overlap_capable = True
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100,
+                 overlap_chunks: int = 1):
         super().__init__()
         self.ignore_index = ignore_index
+        self._tp_overlap_chunks = int(overlap_chunks)
 
     def forward(self, input, label):
+        chunks = tp_overlap.effective_chunks(self._tp_overlap_chunks)
+        if chunks > 1:
+            out = tp_overlap.parallel_cross_entropy(
+                input, label, chunks, self.ignore_index)
+            if out is not None:
+                return out
         logits = mark_sharding(input, batch_spec(input.ndim, last=MODEL_AXIS))
 
         def _ce(lg, lb):

@@ -174,14 +174,21 @@ def _scan_pipeline(stage_fn, xs, n_stages, n_micro, mesh, key_arr,
             prevent_cse=False)
 
         def tick(carry, inp):
-            state, t = carry
+            prev_y, t = carry
+            # the micro-batch boundary ppermute is issued at tick ENTRY
+            # (on the previous tick's output, carried raw) rather than
+            # after the compute that produced it: the hop is then live
+            # while this tick's stage GEMMs run, instead of serializing
+            # at the tick boundary.  Values are identical — the permute
+            # commutes across the carry (permute(zeros) == zeros seeds
+            # tick 0), so the schedule change is bitwise-neutral.
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            state = jax.lax.ppermute(prev_y, "pipe", perm)
             x_in = jnp.where(stage == 0, inp, state)
             y = body(x_in, t)
-            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-            shifted = jax.lax.ppermute(y, "pipe", perm)
             # only the last stage's y is pipeline output
             out_t = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
-            return (shifted, t + 1), out_t
+            return (y, t + 1), out_t
 
         (_, _), ys = jax.lax.scan(tick, (state0, jnp.int32(0)), ticks)
         ys = ys[n_stages - 1:]                       # drop fill ticks
@@ -234,7 +241,10 @@ def _scan_pipeline_interleaved(chunk_fn, xs, n_stages, n_micro, n_virtual,
             prevent_cse=False)
 
         def tick(carry, t):
-            state = carry
+            prev_y = carry
+            # boundary ppermute issued at tick entry (see _scan_pipeline)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            state = jax.lax.ppermute(prev_y, "pipe", perm)
             r = (t - stage) % n_stages
             j = (t - r) % vP
             b = (t - r) // vP
@@ -247,11 +257,9 @@ def _scan_pipeline_interleaved(chunk_fn, xs, n_stages, n_micro, n_virtual,
                 xs_full, m_safe, axis=0, keepdims=False)
             x_in = jnp.where(inject, fresh, state)
             y = body(x_in, c, t)
-            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-            shifted = jax.lax.ppermute(y, "pipe", perm)
             emit = (stage == n_stages - 1) & (j == vP - 1) & valid
             out_t = jnp.where(emit, y, jnp.zeros_like(y))
-            return shifted, out_t
+            return y, out_t
 
         ys = jax.lax.scan(tick, state0, jnp.arange(n_ticks,
                                                    dtype=jnp.int32))[1]

@@ -123,7 +123,21 @@ class _ParallelWrapperBase(Layer):
 
 
 class TensorParallel(_ParallelWrapperBase):
-    pass
+    """Adds the Megatron-TP overlap hook: ``tp_overlap`` (a
+    :class:`~paddle_tpu.distributed.fleet.meta_parallel.overlap.
+    TPOverlapConfig` or a plain chunk count) stamps every capable
+    sublayer so TP GEMMs run the chunked compute/collective-overlap
+    schedule.  Omitted / chunks<=1 leaves the baseline untouched."""
+
+    def __init__(self, layers: Layer, hcg=None, seq_dim=None,
+                 tp_overlap=None, **kwargs):
+        super().__init__(layers, hcg, seq_dim=seq_dim, **kwargs)
+        if tp_overlap is not None:
+            from .overlap import TPOverlapConfig, apply_tp_overlap
+            if not isinstance(tp_overlap, TPOverlapConfig):
+                tp_overlap = TPOverlapConfig(chunks=int(tp_overlap))
+            if tp_overlap.chunks > 1:
+                apply_tp_overlap(layers, tp_overlap)
 
 
 class ShardingParallel(_ParallelWrapperBase):

@@ -38,6 +38,17 @@ class AMPConfig:
 
 
 @dataclass
+class TensorParallelConfig:
+    """Megatron-TP knobs (reference: tensor_parallel_configs message).
+    ``overlap_chunks > 1`` decomposes every TP GEMM into that many
+    sub-GEMMs with per-chunk collectives so XLA interleaves reduces
+    with dots (fleet/meta_parallel/overlap.py); 1 = exact baseline."""
+
+    tensor_init_seed: int = -1
+    overlap_chunks: int = 1
+
+
+@dataclass
 class RecomputeConfig:
     enable: bool = False
     checkpoints: List[str] = field(default_factory=list)
@@ -103,6 +114,7 @@ class DistributedStrategy:
 
     def __init__(self):
         self.hybrid_configs = HybridConfig()
+        self.tensor_parallel_configs = TensorParallelConfig()
         self.amp_configs = AMPConfig()
         self.recompute_configs = RecomputeConfig()
         self.sharding_configs = ShardingConfig()

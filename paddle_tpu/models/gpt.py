@@ -62,6 +62,9 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     tie_word_embeddings: bool = True
     recompute: bool = False
+    # >1 enables chunked compute/collective overlap in every Megatron-TP
+    # layer (distributed/fleet/meta_parallel/overlap.py); 1 = baseline
+    tp_overlap_chunks: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -109,9 +112,11 @@ class GPTAttention(Layer):
         init = I.Normal(std=config.initializer_range)
         # fused qkv, head-major output layout [n_heads, 3*head_dim]
         self.qkv_proj = ColumnParallelLinear(
-            h, 3 * h, weight_attr=init, gather_output=False)
+            h, 3 * h, weight_attr=init, gather_output=False,
+            overlap_chunks=config.tp_overlap_chunks)
         self.out_proj = RowParallelLinear(
-            h, h, weight_attr=init, input_is_parallel=True)
+            h, h, weight_attr=init, input_is_parallel=True,
+            overlap_chunks=config.tp_overlap_chunks)
         self.dropout_p = config.attention_probs_dropout_prob
 
     def forward(self, x, cache_ctx=None):
@@ -149,10 +154,12 @@ class GPTMLP(Layer):
         init = I.Normal(std=config.initializer_range)
         self.fc1 = ColumnParallelLinear(
             config.hidden_size, config.ffn_size, weight_attr=init,
-            gather_output=False)
+            gather_output=False,
+            overlap_chunks=config.tp_overlap_chunks)
         self.fc2 = RowParallelLinear(
             config.ffn_size, config.hidden_size, weight_attr=init,
-            input_is_parallel=True)
+            input_is_parallel=True,
+            overlap_chunks=config.tp_overlap_chunks)
 
     def forward(self, x):
         return self.fc2(F.gelu(self.fc1(x), approximate=True))
@@ -183,7 +190,8 @@ class GPTEmbeddings(Layer):
         super().__init__()
         init = I.Normal(std=config.initializer_range)
         self.word_embeddings = VocabParallelEmbedding(
-            config.vocab_size, config.hidden_size, weight_attr=init)
+            config.vocab_size, config.hidden_size, weight_attr=init,
+            overlap_chunks=config.tp_overlap_chunks)
         self.position_embeddings = Embedding(
             config.max_position_embeddings, config.hidden_size,
             weight_attr=init)
@@ -286,7 +294,13 @@ class GPTForCausalLM(Layer):
             loss_mask = shard_batch(loss_mask, m)
         mp = m.shape.get(MODEL_AXIS, 1) if m is not None else 1
         if mp > 1:
-            crit = GPTPretrainingCriterion(ignore_index=ignore_index)
+            # the criterion is built lazily, after apply_tp_overlap has
+            # already stamped the model — read the root's stamp (or the
+            # config) so the CE rides the chunked schedule too
+            chunks = getattr(self, "_tp_overlap_chunks", 0) \
+                or self.config.tp_overlap_chunks
+            crit = GPTPretrainingCriterion(ignore_index=ignore_index,
+                                           overlap_chunks=chunks)
             return crit(self.forward(input_ids, position_ids), labels,
                         loss_mask)
         h = self.gpt(input_ids, position_ids)
@@ -355,9 +369,10 @@ class GPTPretrainingCriterion(Layer):
     """Vocab-parallel causal-LM loss (reference:
     auto_parallel_gpt_model.py GPTPretrainingCriterion)."""
 
-    def __init__(self, ignore_index: int = -100):
+    def __init__(self, ignore_index: int = -100, overlap_chunks: int = 1):
         super().__init__()
-        self.ce = ParallelCrossEntropy(ignore_index=ignore_index)
+        self.ce = ParallelCrossEntropy(ignore_index=ignore_index,
+                                       overlap_chunks=overlap_chunks)
         self.ignore_index = ignore_index
 
     def forward(self, logits, labels, loss_mask=None):

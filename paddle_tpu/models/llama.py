@@ -65,6 +65,9 @@ class LlamaConfig:
     hidden_dropout_prob: float = 0.0
     tie_word_embeddings: bool = False
     recompute: bool = False
+    # >1 enables chunked compute/collective overlap in every Megatron-TP
+    # layer (distributed/fleet/meta_parallel/overlap.py); 1 = baseline
+    tp_overlap_chunks: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -151,13 +154,16 @@ class LlamaAttention(Layer):
         init = I.Normal(std=config.initializer_range)
         self.q_proj = ColumnParallelLinear(
             h, self.n_heads * self.head_dim, weight_attr=init,
-            has_bias=False, gather_output=False)
+            has_bias=False, gather_output=False,
+            overlap_chunks=config.tp_overlap_chunks)
         # fused K+V, head-major [n_kv, 2*head_dim]
         self.kv_proj = ColumnParallelLinear(
             h, self.n_kv * 2 * self.head_dim, weight_attr=init,
-            has_bias=False, gather_output=False)
+            has_bias=False, gather_output=False,
+            overlap_chunks=config.tp_overlap_chunks)
         self.o_proj = RowParallelLinear(
-            h, h, weight_attr=init, has_bias=False, input_is_parallel=True)
+            h, h, weight_attr=init, has_bias=False, input_is_parallel=True,
+            overlap_chunks=config.tp_overlap_chunks)
         self.rope_theta = config.rope_theta
         self.max_pos = config.max_position_embeddings
         self._rope = None  # built lazily at first forward
@@ -231,10 +237,12 @@ class LlamaMLP(Layer):
         self.ffn = config.ffn_size
         self.gate_up_proj = ColumnParallelLinear(
             config.hidden_size, 2 * config.ffn_size, weight_attr=init,
-            has_bias=False, gather_output=False)
+            has_bias=False, gather_output=False,
+            overlap_chunks=config.tp_overlap_chunks)
         self.down_proj = RowParallelLinear(
             config.ffn_size, config.hidden_size, weight_attr=init,
-            has_bias=False, input_is_parallel=True)
+            has_bias=False, input_is_parallel=True,
+            overlap_chunks=config.tp_overlap_chunks)
 
     def forward(self, x):
         gu = self.gate_up_proj(x)
@@ -268,7 +276,8 @@ class LlamaModel(Layer):
         self.config = config
         init = I.Normal(std=config.initializer_range)
         self.embed_tokens = VocabParallelEmbedding(
-            config.vocab_size, config.hidden_size, weight_attr=init)
+            config.vocab_size, config.hidden_size, weight_attr=init,
+            overlap_chunks=config.tp_overlap_chunks)
         self.layers = LayerList(
             [LlamaDecoderLayer(config)
              for _ in range(config.num_hidden_layers)])

@@ -43,18 +43,32 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["CostLedger", "count_hlo_ops", "opcode_sequence",
            "schedule_fingerprint", "analyze_static_fn", "chip_spec",
-           "CHIP_SPECS", "HLO_OPS"]
+           "collective_exposure", "CHIP_SPECS", "HLO_OPS",
+           "COLLECTIVE_OPS", "ICI_BW"]
 
 # one HLO instruction per line: `%name = <type> opcode(...)` — shared
 # with tools/perf_fingerprint.py (which imports these, so the tracked
 # artifact and the ledger can never count differently)
 _INSTR = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.-]+ = .+? ([\w-]+)\(")
 
-#: opcodes counted into ``hlo_counts`` (collectives split out because
-#: the overlap work is judged on exactly those)
+#: opcodes counted into ``hlo_counts``.  Collectives are split out
+#: because the overlap work is judged on exactly those — including the
+#: async start/done halves TPU schedules emit, so a started-but-
+#: unfinished collective is never invisible to the ledger.
 HLO_OPS = ("dot", "fusion", "custom-call", "all-reduce", "all-gather",
            "reduce-scatter", "collective-permute", "all-to-all", "while",
-           "convolution")
+           "convolution",
+           "all-reduce-start", "all-reduce-done",
+           "all-gather-start", "all-gather-done",
+           "collective-permute-start", "collective-permute-done")
+
+#: every collective opcode ``collective_exposure`` classifies; the
+#: ``*-start`` halves anchor async pairs (their ``*-done`` is the
+#: consumer-side marker, not an independent collective)
+COLLECTIVE_OPS = frozenset((
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "all-reduce-start", "all-gather-start",
+    "collective-permute-start"))
 
 #: per-chip (peak bf16 flops/s, HBM bytes/s) for the analytic roofline.
 #: Keys are the names ``PADDLE_TPU_CHIP`` accepts; the default is v5e,
@@ -76,6 +90,41 @@ def chip_spec(chip: Optional[str] = None) -> Tuple[str, float, float]:
                          f"{sorted(CHIP_SPECS)}")
     peak, bw = CHIP_SPECS[name]
     return name, peak, bw
+
+
+#: usable per-chip ICI egress (B/s) for the analytic exposed-comm time
+#: in tools/step_ablation.py — conservative ~2/3 of aggregate link
+#: bandwidth, matching tools/northstar_projection.py's v5p figure.
+ICI_BW: Dict[str, float] = {
+    "v4": 2.4e11,
+    "v5e": 1.6e11,
+    "v5p": 4.0e11,
+    "v6e": 3.5e11,
+}
+
+# full instruction parse for collective_exposure: name, result type(s),
+# opcode, args — a superset of what _INSTR captures
+_DEF = re.compile(r"^\s*(?:ROOT\s+)?(%?[\w.-]+) = (.+?) ([\w-]+)\((.*)$")
+_SHAPE = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+_OPERAND = re.compile(r"%?([\w.-]+)")
+_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8, "c64": 8, "c128": 16,
+                "f32": 4, "s32": 4, "u32": 4,
+                "f16": 2, "bf16": 2, "s16": 2, "u16": 2,
+                "s8": 1, "u8": 1, "pred": 1}
+
+
+def _result_bytes(type_text: str) -> int:
+    """Largest element of the (possibly tuple) result type in bytes —
+    the payload size of a collective (async starts alias their operand
+    into the result tuple; max picks the payload, not the sum)."""
+    best = 0
+    for dt, dims in _SHAPE.findall(type_text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n * _DTYPE_BYTES.get(dt, 4))
+    return best
 
 
 def opcode_sequence(hlo_text: str) -> List[str]:
@@ -107,6 +156,64 @@ def schedule_fingerprint(hlo_text: str) -> str:
     collective against one dot moves it."""
     seq = "\n".join(opcode_sequence(hlo_text))
     return hashlib.sha256(seq.encode()).hexdigest()[:16]
+
+
+def collective_exposure(hlo_text: str) -> dict:
+    """Classify every collective in an optimized HLO module as
+    **overlapped** or **exposed**.
+
+    A collective is overlapped iff compute (a ``dot``, ``fusion`` or
+    ``convolution``) is scheduled strictly between it and the point its
+    result is first needed: for an async ``*-start`` that window closes
+    at the matching ``*-done``; for a sync collective it closes at the
+    first instruction consuming its result.  A collective whose result
+    is never consumed in its computation is counted exposed (its
+    latency has nothing to hide behind).  The walk is per-computation
+    (fusion/while bodies are separate scopes) and purely textual, so
+    the verdict is as deterministic as the schedule fingerprint.
+
+    Returns ``{"total", "overlapped", "exposed", "exposed_bytes",
+    "collectives": [{"opcode", "overlapped", "bytes"}, ...]}``.
+    """
+    comps: List[List[tuple]] = [[]]
+    for line in hlo_text.splitlines():
+        if line.rstrip().endswith("{"):
+            comps.append([])            # new computation scope
+            continue
+        m = _DEF.match(line)
+        if not m:
+            continue
+        name = m.group(1).lstrip("%")
+        ops = frozenset(_OPERAND.findall(m.group(4)))
+        comps[-1].append((name, m.group(3), ops, _result_bytes(m.group(2))))
+
+    compute_ops = ("dot", "fusion", "convolution")
+    out: List[dict] = []
+    for instrs in comps:
+        for i, (name, opcode, _ops, nbytes) in enumerate(instrs):
+            if opcode not in COLLECTIVE_OPS:
+                continue
+            if opcode.endswith("-start"):
+                done = opcode[:-len("-start")] + "-done"
+                end = next((j for j in range(i + 1, len(instrs))
+                            if instrs[j][1] == done
+                            and name in instrs[j][2]), None)
+            else:
+                end = next((j for j in range(i + 1, len(instrs))
+                            if name in instrs[j][2]), None)
+            overlapped = end is not None and any(
+                instrs[j][1] in compute_ops for j in range(i + 1, end))
+            out.append({"opcode": opcode, "overlapped": overlapped,
+                        "bytes": nbytes})
+
+    exposed = [d for d in out if not d["overlapped"]]
+    return {
+        "total": len(out),
+        "overlapped": len(out) - len(exposed),
+        "exposed": len(exposed),
+        "exposed_bytes": int(sum(d["bytes"] for d in exposed)),
+        "collectives": out,
+    }
 
 
 def _roofline(flops: float, bytes_accessed: float,
@@ -149,6 +256,8 @@ def analyze_static_fn(static_fn, *args, chip: Optional[str] = None) -> dict:
     cost = stats.pop("cost", {})
     flops = float(cost.get("flops", 0.0))
     bytes_accessed = float(cost.get("bytes_accessed", 0.0))
+    exposure = collective_exposure(hlo)
+    exposure.pop("collectives")         # summary only; keep records light
     rec = {
         "flops": flops,
         "bytes_accessed": bytes_accessed,
@@ -157,6 +266,7 @@ def analyze_static_fn(static_fn, *args, chip: Optional[str] = None) -> dict:
         "hlo_instructions": len(opcode_sequence(hlo)),
         "memory": dict(stats),          # argument/output/temp/peak bytes
         "fingerprint": schedule_fingerprint(hlo),
+        "collective_exposure": exposure,
         **_roofline(flops, bytes_accessed, chip),
     }
     return rec

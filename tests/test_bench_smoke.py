@@ -48,6 +48,16 @@ def test_smoke_mode_emits_json_line():
     assert len(out["train_schedule_fingerprint"]) == 16
     assert out["train_step_trace_valid"] == 1.0
     assert out["train_step_trace_events"] > 0
+    # compute/collective overlap (ISSUE 16): the drill compiled the
+    # chunks=1 and chunked TP=4 schedules side by side and bench.py
+    # exits nonzero unless the overlapped program has STRICTLY fewer
+    # exposed collectives at f32 loss parity with a stable fingerprint
+    # and zero new executable-cache keys; the pinned fields put the
+    # exposure count and overlapped-schedule fingerprint on the
+    # one-JSON-line contract
+    assert out["train_tp_overlap_enabled"] == 1.0
+    assert out["train_tp_overlap_exposed_collectives"] > 0
+    assert len(out["train_tp_overlap_fingerprint"]) == 16
 
 
 @pytest.mark.slow

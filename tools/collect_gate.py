@@ -157,6 +157,9 @@ TIER1_CRITICAL = {
         "request journal, crash recovery & rolling weight hot-swap",
     "tests/test_spec_decode.py":
         "speculative decoding: draft/verify/accept parity & rollback",
+    "tests/test_tp_overlap.py":
+        "TP compute/collective overlap: chunked-schedule parity & "
+        "exposed-collective pins",
 }
 
 

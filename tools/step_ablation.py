@@ -180,12 +180,16 @@ def offline_ablation(smoke: bool = True, batch: int = None,
 
     Returns ``{"mode": "offline", "chip", "variants": {name:
     {flops, bytes_accessed, roofline_step_ms, analytic_mfu, dot,
-    fusion, fingerprint}}, "deltas": {opt_*, bwd_*}}`` — the
-    flop/byte-level answer to "where does the step go" that needs no
-    TPU, and the regression surface the overlap work (ROADMAP item 3)
-    will move."""
+    fusion, fingerprint}}, "deltas": {opt_*, bwd_*},
+    "comm_exposure": {name: {total, overlapped, exposed,
+    exposed_bytes, exposed_ms}}}`` — the flop/byte-level answer to
+    "where does the step go" that needs no TPU.  ``comm_exposure``
+    classifies every collective in the optimized HLO as
+    overlapped-with-compute vs exposed (the schedule surface the TP
+    overlap work moves) and prices the exposed bytes at the chip's
+    usable ICI bandwidth."""
     import numpy as np
-    from paddle_tpu.obs.hlo_cost import CostLedger
+    from paddle_tpu.obs.hlo_cost import CostLedger, ICI_BW
 
     programs, x, y, model, cfg, seq, batch = build_ablation_programs(
         smoke=smoke, batch=batch)
@@ -210,6 +214,14 @@ def offline_ablation(smoke: bool = True, batch: int = None,
             "flops_vs_6nd": rec["flops_vs_6nd"],
             "fingerprint": rec["fingerprint"],
         }
+    out["comm_exposure"] = {}
+    ici = ICI_BW[ledger.chip]
+    for name, _ in programs:
+        exp = ledger.programs[name].get("collective_exposure")
+        if exp is None:
+            continue
+        out["comm_exposure"][name] = dict(
+            exp, exposed_ms=round(exp["exposed_bytes"] / ici * 1e3, 6))
     v = out["variants"]
     out["deltas"] = {
         # what the optimizer adds on top of fwd+bwd, and backward on
