@@ -1052,6 +1052,171 @@ def _tp_overlap_drill():
     }
 
 
+def _elastic_drill_child():
+    """Child half of the elastic drill (``--elastic-drill-child``): on
+    the 8-device virtual CPU mesh, train at dp=4, abandon the run past
+    its last committed generation, relaunch the rig at dp=2 over half
+    the devices, and resume through ``ResilientLoop`` — proving the
+    resharded state bitwise identical to the generation's global arrays,
+    replaying exactly the uncommitted steps, losing zero samples of the
+    elastic data schedule, and adding zero steady-state compiles after
+    the post-resume rebuild.  Prints one JSON line."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import checkpoint as ckpt, mesh as mesh_mod
+    from paddle_tpu.distributed.fault_tolerance import ResilientLoop
+    from paddle_tpu.distributed.reshard import (
+        ElasticDataSchedule, verify_resharded)
+    from paddle_tpu.distributed.sharding_spec import shard_parameter
+    from paddle_tpu.obs import CompileLedger
+
+    G, STEPS, CUT = 8, 8, 5    # global batch; total steps; interrupt point
+
+    def rig(dp, mp, devices=None):
+        mesh = mesh_mod.hybrid_mesh(dp=dp, mp=mp, devices=devices)
+        mesh_mod.set_global_mesh(mesh)
+        paddle.seed(11)
+        net = nn.Linear(8, 4, weight_attr=paddle.ParamAttr(name="el_w"),
+                        bias_attr=paddle.ParamAttr(name="el_b"))
+        shard_parameter(net.weight, P(None, "model"), mesh)
+        opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                     parameters=net.parameters())
+        sched = ElasticDataSchedule(G)
+        losses = []
+
+        def step_fn(step):
+            # batch derived from the schedule's step window: the sample
+            # stream is a pure function of the step, world-independent
+            lo, _hi = sched.step_window(step)
+            rs = np.random.RandomState(lo)
+            x = paddle.to_tensor(rs.randn(G, 8).astype(np.float32))
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+
+        return {
+            "net": net, "opt": opt, "step_fn": step_fn, "losses": losses,
+            "sched": sched,
+            "state_fn": lambda: {"model": net.state_dict(),
+                                 "opt": opt.state_dict()},
+            "restore_fn": lambda s: (net.set_state_dict(s["model"]),
+                                     opt.set_state_dict(s["opt"])),
+        }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # oracle: uninterrupted dp=4 run
+        r0 = rig(4, 2)
+        ResilientLoop(os.path.join(tmp, "ref"), r0["state_fn"],
+                      r0["restore_fn"], save_every=None,
+                      verbose=False).run(r0["step_fn"], STEPS)
+        mesh_mod.set_global_mesh(None)
+
+        # life 1 at dp=4: cadence saves, no final commit (the "kill")
+        root = os.path.join(tmp, "ck")
+        r1 = rig(4, 2)
+        ResilientLoop(root, r1["state_fn"], r1["restore_fn"],
+                      save_every=2, save_final=False,
+                      verbose=False).run(r1["step_fn"], CUT)
+        gen, path = ckpt.latest_valid(root)
+        ref_gen = ckpt.load_state_dict(path, return_numpy=True)
+        mesh_mod.set_global_mesh(None)
+
+        # life 2 at dp=2 over HALF the devices
+        r2 = rig(2, 2, devices=jax.devices()[:4])
+        t0 = time.perf_counter()
+        probe = ResilientLoop(root, r2["state_fn"], r2["restore_fn"],
+                              verbose=False)
+        resumed = probe.resume()
+        reconfig_ms = (time.perf_counter() - t0) * 1e3
+        digest_ok = 1.0
+        try:
+            verify_resharded({"model": r2["net"].state_dict(),
+                              "opt": r2["opt"].state_dict()},
+                             ref_gen["user"])
+        except ValueError as e:
+            digest_ok = 0.0
+            print(str(e)[:800], file=sys.stderr)
+        ledger = CompileLedger(name="elastic")
+        loop2 = ResilientLoop(root, r2["state_fn"], r2["restore_fn"],
+                              save_every=2, verbose=False,
+                              compile_ledger=ledger)
+        loop2.run(r2["step_fn"], STEPS)
+        lost = r2["sched"].lost_samples([(0, gen, 4), (gen, STEPS, 2)])
+        tail = r0["losses"][resumed:]
+        delta = max(abs(a - b) for a, b in zip(r2["losses"], tail)) \
+            if r2["losses"] and len(r2["losses"]) == len(tail) else -1.0
+    print(json.dumps({
+        "resumed_gen": resumed,
+        "replayed_steps": CUT - resumed,
+        "reconfig_ms": round(reconfig_ms, 3),
+        "loop_reconfigs": probe.reconfigs + loop2.reconfigs,
+        "resharded_tensors": len(loop2.reshard_report),
+        "digest_ok": digest_ok,
+        "lost_samples": lost,
+        "steady_misses": ledger.steady_state_misses,
+        "loss_tail_delta": delta,
+    }))
+
+
+def _elastic_drill():
+    """Elastic reconfiguration drill (ISSUE 17): run the dp=4 → dp=2
+    resume in a subprocess pinned to the virtual CPU mesh, and fail the
+    bench structured if the resharded state is not bitwise identical to
+    the committed generation, if any sample of the elastic data
+    schedule is lost or duplicated across the world change, or if the
+    post-resume steady state recompiled."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    xla = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        env["XLA_FLAGS"] = \
+            (xla + " --xla_force_host_platform_device_count=8").strip()
+    env.pop("PADDLE_TPU_BENCH_SMOKE", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--elastic-drill-child"],
+        capture_output=True, text=True, env=env, timeout=600)
+    if proc.returncode != 0:
+        fail_structured("elastic drill crashed: "
+                        + (proc.stderr or proc.stdout)[-800:])
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    if not lines:
+        fail_structured(f"elastic drill emitted no JSON: "
+                        f"{proc.stdout[-400:]!r}")
+    d = json.loads(lines[-1])
+    if d["digest_ok"] != 1.0:
+        fail_structured(
+            "elastic resume resharded state is NOT bitwise identical to "
+            f"the committed generation: {d}")
+    if d["lost_samples"] != 0:
+        fail_structured(
+            f"elastic reconfiguration lost/duplicated samples: {d}")
+    if d["steady_misses"]:
+        fail_structured(
+            f"post-resume steady state recompiled: {d}")
+    if d["loop_reconfigs"] < 2:       # probe resume + loop2 resume
+        fail_structured(
+            f"topology change was not detected as a reconfig: {d}")
+    if not 0 <= d["loss_tail_delta"] <= 1e-4:
+        fail_structured(
+            f"elastic resume broke loss parity with the uninterrupted "
+            f"run: {d}")
+    return {
+        "train_elastic_reconfig_ms": d["reconfig_ms"],
+        "train_elastic_replayed_steps": d["replayed_steps"],
+        "train_elastic_lost_samples": d["lost_samples"],
+    }
+
+
 def main():
     import os
     import jax
@@ -1143,6 +1308,10 @@ def main():
     # mesh that the chunked TP schedule strictly reduces exposed
     # collectives at f32 loss parity, and report its exposure metrics
     overlap = _tp_overlap_drill()
+    # elastic reconfiguration drill (ISSUE 17): prove a dp=4 → dp=2
+    # resume reshards bitwise-identically, replays only uncommitted
+    # steps, and loses zero samples of the elastic data schedule
+    elastic = _elastic_drill()
     out = {
         "metric": "gpt2_345m_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -1164,6 +1333,7 @@ def main():
         "train_cost_chip": cost.chip,
         **rollback,
         **overlap,
+        **elastic,
     }
     print(json.dumps(out))
 
@@ -1177,6 +1347,11 @@ if __name__ == "__main__":
         # child half of the overlap drill: runs on the 8-device virtual
         # CPU mesh the parent pinned via env, never touches the tunnel
         _tp_overlap_drill_child()
+        sys.exit(0)
+    if "--elastic-drill-child" in sys.argv:
+        # child half of the elastic drill: dp=4 → dp=2 reconfigured
+        # resume on the 8-device virtual CPU mesh the parent pinned
+        _elastic_drill_child()
         sys.exit(0)
     if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
         import jax

@@ -28,6 +28,11 @@ from .checkpoint import (
 )
 from . import fault_tolerance  # noqa: E402
 from .fault_tolerance import ResilientLoop
+from . import reshard  # noqa: E402
+from .reshard import (
+    tensor_digest, state_digests, verify_resharded, world_descriptor,
+    ElasticDataSchedule,
+)
 from .sharding_spec import (
     mark_sharding, shard_parameter, set_param_spec, get_param_spec, batch_spec,
 )

@@ -364,13 +364,41 @@ def _read_region(shards_meta, base: str, out_idx, shape, np_dtype,
     return _from_disk_view(out, dtype_name)
 
 
-def _target_sharding(name, meta, template_value, mesh: Optional[Mesh]):
+def _spec_axes(entries) -> set:
+    """Flat set of mesh axis names referenced by a saved/loaded spec."""
+    axes = set()
+    for e in entries or ():
+        if isinstance(e, (list, tuple)):
+            axes.update(e)
+        elif e is not None:
+            axes.add(e)
+    return axes
+
+
+def _note_reshard(report, name, meta, source, loaded_entries):
+    """Record how one tensor landed: which saved-spec axes survived onto
+    the destination and which were dropped (replicated over).  This is
+    what makes the reshard behavior *loud* — dropping an axis is correct
+    (it is how a checkpoint lands on a smaller mesh) but must never be
+    silent."""
+    if report is None:
+        return
+    saved = meta.get("spec")
+    kept = _spec_axes(loaded_entries)
+    dropped = sorted(_spec_axes(saved) - kept)
+    report[name] = {"source": source, "saved_spec": saved,
+                    "kept_axes": sorted(kept), "dropped_axes": dropped}
+
+
+def _target_sharding(name, meta, template_value, mesh: Optional[Mesh],
+                     report: Optional[dict] = None):
     m = mesh or mesh_mod.get_global_mesh()
     if template_value is not None:
         tv = _np_of(template_value)
         sh = getattr(tv, "sharding", None)
         if sh is not None and getattr(sh, "mesh", None) is not None \
                 and not getattr(sh.mesh, "empty", False):
+            _note_reshard(report, name, meta, "template", tuple(sh.spec))
             return sh
     if m is not None:
         spec_entries = meta.get("spec")
@@ -382,13 +410,17 @@ def _target_sharding(name, meta, template_value, mesh: Optional[Mesh]):
                     entries.append(kept if kept else None)
                 else:
                     entries.append(e if (e is None or e in m.shape) else None)
+            _note_reshard(report, name, meta, "saved_spec", entries)
             return NamedSharding(m, P(*entries))
+        _note_reshard(report, name, meta, "replicated", ())
         return NamedSharding(m, P())
+    _note_reshard(report, name, meta, "host", ())
     return None
 
 
 def load_state_dict(path: str, state_dict: Optional[Dict[str, Any]] = None,
-                    mesh: Optional[Mesh] = None, return_numpy: bool = False):
+                    mesh: Optional[Mesh] = None, return_numpy: bool = False,
+                    reshard_report: Optional[dict] = None):
     """Load a sharded checkpoint, resharding to the target placement.
 
     - With a template ``state_dict`` (e.g. ``model.state_dict()``): each
@@ -397,6 +429,11 @@ def load_state_dict(path: str, state_dict: Optional[Dict[str, Any]] = None,
     - Without a template: tensors load under their saved spec filtered onto
       the active global mesh (replicated where axes disappeared), or as
       numpy with ``return_numpy=True``.
+    - ``reshard_report`` (a caller-supplied dict) is filled per tensor with
+      ``{"source", "saved_spec", "kept_axes", "dropped_axes"}`` — axes the
+      destination placement dropped relative to the saved spec are listed,
+      never silently swallowed (docs/RESILIENCE.md "Elastic
+      reconfiguration").
     """
     with open(os.path.join(path, _INDEX)) as f:
         index = json.load(f)
@@ -417,7 +454,8 @@ def load_state_dict(path: str, state_dict: Optional[Dict[str, Any]] = None,
                 dtype_name)
             out_flat[name] = full
             continue
-        sharding = _target_sharding(name, meta, tmpl_flat.get(name), mesh)
+        sharding = _target_sharding(name, meta, tmpl_flat.get(name), mesh,
+                                    report=reshard_report)
         if sharding is None:
             arr = _read_region(
                 meta["shards"], path,

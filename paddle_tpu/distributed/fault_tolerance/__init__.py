@@ -8,13 +8,20 @@ plus the cheap recovery tier: in-graph divergence detection
 (`DivergenceSentry`), host-RAM snapshot rollback (`MemorySnapshotRing`),
 and automatic rollback-and-skip with `SentryEscalation` fail-stop after
 `max_rollbacks` consecutive failures.
+
+Elastic mesh health (ISSUE 17): `MeshWatchdog` adds the per-host
+heartbeat / wedged-collective deadline / straggler-EMA tier over the
+same coordinator duck the elastic manager uses; topology-change-safe
+resume lives in `ResilientLoop.resume` + `distributed.reshard`.
 """
 from ..fleet.elastic.manager import ELASTIC_EXIT_CODE
 from .injection import (
     FaultPlan, ServingFaultPlan, ReplicaScopedFaultPlan, InjectedFault,
     corrupt_shard, SERVING_FAULT_POINTS, TRAIN_FAULT_POINTS,
+    ELASTIC_FAULT_POINTS,
 )
 from .memory_checkpoint import MemorySnapshotRing, restore_packed_state
+from .mesh_watchdog import MeshWatchdog
 from .resilient_loop import ResilientLoop, pack_state
 from .sentry import (
     DivergenceSentry, SentryEscalation, SentryReport, global_grad_norm,
@@ -26,8 +33,8 @@ from .watchdog import StepWatchdog, dump_all_stacks
 __all__ = [
     "ResilientLoop", "StepWatchdog", "FaultPlan", "ServingFaultPlan",
     "ReplicaScopedFaultPlan", "InjectedFault", "SERVING_FAULT_POINTS",
-    "TRAIN_FAULT_POINTS", "corrupt_shard", "dump_all_stacks",
-    "ELASTIC_EXIT_CODE", "pack_state",
+    "TRAIN_FAULT_POINTS", "ELASTIC_FAULT_POINTS", "corrupt_shard",
+    "dump_all_stacks", "ELASTIC_EXIT_CODE", "pack_state", "MeshWatchdog",
     "DivergenceSentry", "SentryEscalation", "SentryReport",
     "MemorySnapshotRing", "restore_packed_state", "global_grad_norm",
     "ANOMALY_NONFINITE_LOSS", "ANOMALY_NONFINITE_GRAD",

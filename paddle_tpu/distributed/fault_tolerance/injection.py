@@ -41,6 +41,19 @@ Each rule fires at most once per step (a post-rollback replay of steps
 *before* the window re-corrupts nothing, and the blocklist keeps the
 window itself from re-running).
 
+Elastic chaos (ISSUE 17) rides the same env var with two more points:
+
+- ``train.straggler@N[xM][:stall=S]``  sleep ``S`` seconds (default
+  0.25) inside every step of ``[N, N+M)`` — a slow host; the mesh
+  watchdog's step-time EMA must flag it as a straggler (>k× the
+  fleet median) and escalate;
+- ``elastic.heartbeat@N[xM]``  drop this host's Nth..(N+M−1)th
+  heartbeats — the lease under its health key goes stale exactly as if
+  the host wedged, exercising the membership-shrink path without
+  killing anything.  Beat-count keyed (like serving call counts), not
+  step keyed: the heartbeat thread consults
+  :meth:`FaultPlan.should_drop_heartbeat` before each publish.
+
 Serving fault points (``ServingFaultPlan``) extend the same env-driven
 deterministic-trigger discipline to the serving engine: a fault is keyed
 to the Nth call of a named engine fault point (``serving.prefill``,
@@ -78,7 +91,7 @@ from typing import Optional
 
 __all__ = ["FaultPlan", "ServingFaultPlan", "ReplicaScopedFaultPlan",
            "InjectedFault", "corrupt_shard", "SERVING_FAULT_POINTS",
-           "TRAIN_FAULT_POINTS"]
+           "TRAIN_FAULT_POINTS", "ELASTIC_FAULT_POINTS"]
 
 ENV_DIE_AT_STEP = "PADDLE_TPU_FT_DIE_AT_STEP"
 ENV_DIE_SIGNAL = "PADDLE_TPU_FT_DIE_SIGNAL"
@@ -89,12 +102,23 @@ ENV_TRAIN_FAULTS = "PADDLE_TPU_FT_TRAIN_FAULTS"
 
 #: Step-keyed numerical fault points: data-side corruption applied via
 #: :meth:`FaultPlan.corrupt_batch` (shape/dtype-preserving, so compiled
-#: train steps see the fault with zero new executable-cache keys).
-TRAIN_FAULT_POINTS = ("train.nan", "train.spike")
+#: train steps see the fault with zero new executable-cache keys) —
+#: plus ``train.straggler``, a host-side per-step stall (the mesh
+#: watchdog's EMA surface; it never touches batch data).
+TRAIN_FAULT_POINTS = ("train.nan", "train.spike", "train.straggler")
+
+#: Elastic fault points (beat-count keyed, not step keyed): the mesh
+#: watchdog consults :meth:`FaultPlan.should_drop_heartbeat` before each
+#: health publish.
+ELASTIC_FAULT_POINTS = ("elastic.heartbeat",)
 
 #: default multiplier for ``train.spike`` (finite, but far past any
 #: sane ``spike_factor`` threshold)
 DEFAULT_SPIKE_FACTOR = 1e4
+
+#: default per-step stall for ``train.straggler`` — small in wall time,
+#: huge relative to a fake-device test step (µs), so the EMA flags it
+DEFAULT_STRAGGLER_STALL = 0.25
 
 #: Fault points the serving engine checks (engine.py _step_call/_emit;
 #: ``serving.prefix_lookup`` fires inside the paged engine's host-side
@@ -124,32 +148,45 @@ def _parse_signal(spec: str) -> int:
     return int(getattr(signal, name))
 
 
+#: which option key each point accepts (None = no options)
+_TRAIN_FAULT_OPTS = {"train.nan": None, "train.spike": "factor",
+                     "train.straggler": "stall", "elastic.heartbeat": None}
+
+
 def _parse_train_faults(raw: str) -> list:
-    """``point@N[xM][:factor=F]`` comma-separated specs →
-    [{"kind", "at", "times", "factor"}]."""
+    """``point@N[xM][:factor=F|:stall=S]`` comma-separated specs →
+    [{"kind", "at", "times", "factor", "stall"}]."""
     rules = []
+    valid = TRAIN_FAULT_POINTS + ELASTIC_FAULT_POINTS
     for spec in (s.strip() for s in raw.split(",")):
         if not spec:
             continue
         point, sep, rest = spec.partition("@")
-        if not sep or point not in TRAIN_FAULT_POINTS:
+        if not sep or point not in valid:
             raise ValueError(
                 f"bad train fault spec {spec!r}: expected "
-                f"point@N[xM][:factor=F] with point in {TRAIN_FAULT_POINTS}")
+                f"point@N[xM][:factor=F|:stall=S] with point in {valid}")
         window, _, opt = rest.partition(":")
         at, _, times = window.partition("x")
         factor = DEFAULT_SPIKE_FACTOR
+        stall = DEFAULT_STRAGGLER_STALL
         if opt:
             key, _, val = opt.partition("=")
-            if key != "factor":
+            want = _TRAIN_FAULT_OPTS[point]
+            if want is None:
+                raise ValueError(
+                    f"{point} takes no options (got {spec!r})")
+            if key != want:
                 raise ValueError(f"bad train fault option {opt!r} in "
-                                 f"{spec!r}: only 'factor=<f>'")
-            factor = float(val)
-        if point == "train.nan" and opt:
-            raise ValueError(f"train.nan takes no options (got {spec!r})")
+                                 f"{spec!r}: only '{want}=<f>'")
+            if key == "factor":
+                factor = float(val)
+            else:
+                stall = float(val)
         rules.append({"kind": point.split(".")[1], "at": int(at),
                       "times": int(times) if times else 1,
-                      "factor": factor, "fired_steps": set()})
+                      "factor": factor, "stall": stall,
+                      "fired_steps": set()})
         if rules[-1]["at"] < 0 or rules[-1]["times"] < 1:
             raise ValueError(f"bad train fault window in {spec!r}")
     return rules
@@ -170,6 +207,7 @@ class FaultPlan:
         self.train_faults = list(train_faults or [])
         self._fired_die = False
         self._fired_stall = False
+        self._heartbeats = 0
 
     @classmethod
     def from_env(cls, env=os.environ) -> "FaultPlan":
@@ -183,18 +221,23 @@ class FaultPlan:
             train_faults=_parse_train_faults(env.get(ENV_TRAIN_FAULTS, "")))
 
     def add_train_fault(self, point: str, at_step: int, times: int = 1,
-                        factor: float = DEFAULT_SPIKE_FACTOR) -> "FaultPlan":
-        """In-process arming of a ``train.nan``/``train.spike`` rule (the
-        env path parses the same shape)."""
-        if point not in TRAIN_FAULT_POINTS:
+                        factor: float = DEFAULT_SPIKE_FACTOR,
+                        stall: float = DEFAULT_STRAGGLER_STALL
+                        ) -> "FaultPlan":
+        """In-process arming of a ``train.*``/``elastic.*`` rule (the
+        env path parses the same shape).  ``at_step`` is a step for the
+        train points and a 1-based heartbeat number for
+        ``elastic.heartbeat``."""
+        valid = TRAIN_FAULT_POINTS + ELASTIC_FAULT_POINTS
+        if point not in valid:
             raise ValueError(f"unknown train fault point {point!r}; want "
-                             f"one of {TRAIN_FAULT_POINTS}")
+                             f"one of {valid}")
         if at_step < 0 or times < 1:
             raise ValueError("at_step must be >= 0 and times >= 1")
         self.train_faults.append(
             {"kind": point.split(".")[1], "at": int(at_step),
              "times": int(times), "factor": float(factor),
-             "fired_steps": set()})
+             "stall": float(stall), "fired_steps": set()})
         return self
 
     @property
@@ -208,9 +251,30 @@ class FaultPlan:
         if self.stall_at_step == step and not self._fired_stall:
             self._fired_stall = True
             time.sleep(self.stall_seconds)
+        for r in self.train_faults:
+            # the straggler stall fires EVERY step of its window (a slow
+            # host stays slow), once per step so replays stay clean
+            if r["kind"] == "straggler" \
+                    and r["at"] <= step < r["at"] + r["times"] \
+                    and step not in r["fired_steps"]:
+                r["fired_steps"].add(step)
+                time.sleep(r["stall"])
         if self.die_at_step == step and not self._fired_die:
             self._fired_die = True
             os.kill(os.getpid(), self.die_signal)
+
+    def should_drop_heartbeat(self) -> bool:
+        """Count one heartbeat attempt; True if an ``elastic.heartbeat``
+        rule covers it (1-based beat number, like serving call counts).
+        The mesh watchdog consults this before every health publish and
+        skips the publish on True — the lease goes stale exactly as if
+        the host wedged."""
+        self._heartbeats += 1
+        for r in self.train_faults:
+            if r["kind"] == "heartbeat" \
+                    and r["at"] <= self._heartbeats < r["at"] + r["times"]:
+                return True
+        return False
 
     def corrupt_batch(self, step: int, batch):
         """Apply any armed ``train.*`` rule for ``step`` to a batch —
@@ -222,7 +286,8 @@ class FaultPlan:
         production loop."""
         rule = None
         for r in self.train_faults:
-            if r["at"] <= step < r["at"] + r["times"] \
+            if r["kind"] in ("nan", "spike") \
+                    and r["at"] <= step < r["at"] + r["times"] \
                     and step not in r["fired_steps"]:
                 rule = r
                 break

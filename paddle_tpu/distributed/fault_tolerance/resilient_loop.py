@@ -78,14 +78,24 @@ def pack_state(user_state: Dict[str, Any], step: int,
 
     ``scaler`` (an ``amp.GradScaler``) adds an ``@scaler`` entry so an
     AMP run resumes — or rolls back — with its live dynamic loss scale
-    instead of re-warming from ``init_loss_scaling``."""
+    instead of re-warming from ``init_loss_scaling``.
+
+    ``@world`` records the packing topology (process/device counts and
+    mesh axis sizes) and ``@wall`` the commit wall time — both literal
+    entries ``restore_packed_state`` ignores; the elastic resume path
+    reads them to detect a topology change and to wall-anchor the
+    cross-restart timeline link (docs/RESILIENCE.md "Elastic
+    reconfiguration")."""
     from ...core.rng import get_rng_state
+    from ..reshard import world_descriptor
 
     state: Dict[str, Any] = {"user": user_state, "@step": int(step)}
     if include_rng:
         state["@rng"] = get_rng_state()
     if scaler is not None:
         state["@scaler"] = scaler.state_dict()
+    state["@world"] = world_descriptor()
+    state["@wall"] = time.time()
     return state
 
 
@@ -109,7 +119,8 @@ class ResilientLoop:
                  flight_capacity: int = 256,
                  timeline: Optional[StepTimeline] = None,
                  compile_ledger=None,
-                 cost_ledger=None):
+                 cost_ledger=None,
+                 mesh_watchdog=None):
         if save_every is not None and save_every < 1:
             raise ValueError("save_every must be >= 1 (or None to disable)")
         if keep_last is not None and keep_last < 1:
@@ -145,13 +156,28 @@ class ResilientLoop:
         #: step once, post-warmup) — its analytic MFU / fingerprint
         #: ride the train_stats()/metrics scrape surface
         self.cost_ledger = cost_ledger
+        #: a fault_tolerance.MeshWatchdog (ISSUE 17): per-host heartbeat
+        #: + wedged-collective deadline + straggler EMA; the loop feeds
+        #: it step boundaries alongside the StepWatchdog and surfaces
+        #: its counters through train_stats()["elastic"]
+        self.mesh_watchdog = mesh_watchdog
+        #: elastic reconfiguration counters (ISSUE 17): bumped when
+        #: resume() restores a generation packed on a DIFFERENT world
+        self.reconfigs = 0
+        self.last_reconfig_s: Optional[float] = None
+        #: per-tensor reshard report from the last resume()'s
+        #: load_state_dict (kept/dropped mesh axes; see
+        #: checkpoint.load_state_dict)
+        self.reshard_report: Dict[str, Any] = {}
+        self._reconfigured: Optional[Dict[str, Any]] = None
         self._preempt_sig: Optional[int] = None
         self._fault_plan = FaultPlan.from_env()
         # join the profiler.train_stats() scrape surface only when
         # something is armed (same contract as Model.fit): a bare loop
         # would export an empty row per construction otherwise
         if self.timeline.enabled or sentry is not None \
-                or compile_ledger is not None or cost_ledger is not None:
+                or compile_ledger is not None or cost_ledger is not None \
+                or mesh_watchdog is not None:
             from ... import profiler as _profiler
 
             _profiler._register_train_stats(self)
@@ -175,20 +201,56 @@ class ResilientLoop:
 
     def resume(self) -> int:
         """Restore the newest valid generation; returns the step index to
-        continue from (0 on a fresh start)."""
+        continue from (0 on a fresh start).
+
+        Topology-change-safe (ISSUE 17): the restore always goes through
+        ``load_state_dict`` with the live ``state_fn()`` template, so
+        every tensor lands under the CURRENT mesh's sharding regardless
+        of the world that packed it — resharding is the load path, not a
+        special case.  When the packed ``@world`` descriptor differs
+        from the live one the loop records a reconfiguration (counters,
+        reshard report, wall-anchored timeline link on the first
+        attempt) instead of failing."""
+        from ..reshard import world_descriptor
+
         found = ckpt.latest_valid(self.ckpt_dir)
         if found is None:
             self._log(f"no valid generation under {self.ckpt_dir}; "
                       "starting fresh")
             return 0
         step, path = found
+        t0 = time.monotonic()
         template: Dict[str, Any] = {"user": self.state_fn(), "@step": None}
         if self.include_rng:
             template["@rng"] = None
-        state = ckpt.load_state_dict(path, template)
+        report: Dict[str, Any] = {}
+        state = ckpt.load_state_dict(path, template, reshard_report=report)
         resumed = restore_packed_state(
             state, self.restore_fn, scaler=self.scaler,
             include_rng=self.include_rng)
+        self.reshard_report = report
+        saved_world = state.get("@world")
+        live_world = world_descriptor()
+        if isinstance(saved_world, dict) and \
+                dict(saved_world) != live_world:
+            self.reconfigs += 1
+            self.last_reconfig_s = time.monotonic() - t0
+            self._reconfigured = {
+                "origin_wall": state.get("@wall"),
+                "from_world": dict(saved_world),
+                "to_world": live_world,
+                "reconfig_ms": round(self.last_reconfig_s * 1e3, 3),
+            }
+            dropped = sorted(n for n, r in report.items()
+                             if r.get("dropped_axes"))
+            self._log(
+                f"topology change on resume: {dict(saved_world)} -> "
+                f"{live_world}; resharded {len(report)} tensor(s) onto "
+                f"the new mesh ({self.last_reconfig_s * 1e3:.1f}ms), "
+                f"{len(dropped)} with dropped axes"
+                + (f" ({', '.join(dropped[:4])}"
+                   f"{', ...' if len(dropped) > 4 else ''})"
+                   if dropped else ""))
         self._log(f"resumed from generation {step} (step {resumed})")
         return resumed
 
@@ -260,11 +322,29 @@ class ResilientLoop:
                 self.last_rollback_recovery_s * 1e3, 3)
         return out
 
+    def elastic_stats(self) -> dict:
+        """JSON-ready elastic counters (ISSUE 17): reconfiguration
+        count/latency and reshard breadth from resume(), plus the mesh
+        watchdog's membership/heartbeat/straggler counters when one is
+        attached.  Empty when neither is live."""
+        out: Dict[str, Any] = {}
+        if self.reconfigs:
+            out["reconfigs"] = self.reconfigs
+            out["last_reconfig_ms"] = round(self.last_reconfig_s * 1e3, 3)
+            out["resharded_tensors"] = len(self.reshard_report)
+            out["dropped_axis_tensors"] = sum(
+                1 for r in self.reshard_report.values()
+                if r.get("dropped_axes"))
+        if self.mesh_watchdog is not None:
+            out["watchdog"] = self.mesh_watchdog.stats()
+        return out
+
     def train_stats(self) -> dict:
         """The training-observatory snapshot (ISSUE 13): timeline
-        counters, compile ledger, sentry/rollback counters — surfaced
-        process-wide through ``profiler.train_stats()`` and flattened
-        into the metrics exposition alongside the serving stacks."""
+        counters, compile ledger, sentry/rollback counters, elastic
+        counters — surfaced process-wide through
+        ``profiler.train_stats()`` and flattened into the metrics
+        exposition alongside the serving stacks."""
         out: Dict[str, Any] = {"name": "training"}
         if self.timeline.enabled:
             out["timeline"] = self.timeline.counters()
@@ -275,6 +355,9 @@ class ResilientLoop:
         sen = self.sentry_stats()
         if sen:
             out["sentry"] = sen
+        ela = self.elastic_stats()
+        if ela:
+            out["elastic"] = ela
         return out
 
     # -- preemption ------------------------------------------------------
@@ -350,8 +433,15 @@ class ResilientLoop:
                                  exit_code=self.exit_code,
                                  on_timeout=self._on_watchdog_timeout)
                     if self.watchdog_timeout else None)
+        mesh_wd = self.mesh_watchdog
         saved_handlers = self._install_handlers()
         completed = start
+        # one-shot: the resume() that preceded us crossed a topology
+        # change — the FIRST attempt on the new world carries the
+        # timeline's `reconfigured` event (wall-anchored back to the
+        # restored generation's commit) and ends `reconfigured`
+        reconfig = self._reconfigured
+        self._reconfigured = None
 
         def _commit(n, resume_step=None):
             # checkpoint commits may legally be slow (big state, slow
@@ -360,19 +450,30 @@ class ResilientLoop:
             # forever dying mid-save at the same boundary
             if watchdog is not None:
                 watchdog.pause()
+            if mesh_wd is not None:
+                mesh_wd.pause()
             self._save(n)
-            if watchdog is not None and resume_step is not None:
-                watchdog.notify(resume_step)
+            if resume_step is not None:
+                if watchdog is not None:
+                    watchdog.notify(resume_step)
+                if mesh_wd is not None:
+                    mesh_wd.notify(resume_step)
 
         try:
             if watchdog is not None:
                 watchdog.start()
+            if mesh_wd is not None:
+                mesh_wd.start()
             if sentry is not None:
                 # seed the ring: a rollback target exists from step one
                 self._mem_snapshot(start)
             step = start
             while step < num_steps:
                 tl.begin_step(step)
+                reconfigured_attempt = reconfig is not None
+                if reconfigured_attempt:
+                    tl.on_reconfigured(step, **reconfig)
+                    reconfig = None
                 skipped = sentry is not None and sentry.should_skip(step)
                 if skipped:
                     # blocklisted data window: step_fn is never called,
@@ -386,6 +487,8 @@ class ResilientLoop:
                 else:
                     if watchdog is not None:
                         watchdog.notify(step)
+                    if mesh_wd is not None:
+                        mesh_wd.notify(step)
                     self._fault_plan.fire(step)
                     with tl.phase("step_dispatch"):
                         step_fn(step)
@@ -408,6 +511,8 @@ class ResilientLoop:
                                 # the watchdog os._exit()s mid-save;
                                 # the next iteration's notify re-arms
                                 watchdog.pause()
+                            if mesh_wd is not None:
+                                mesh_wd.pause()
                             if action == "escalate":
                                 self._escalate(step, report)
                             target = self._restore_newest_snapshot()
@@ -454,7 +559,9 @@ class ResilientLoop:
                     _commit(completed)
                     self._log(f"preempted at step boundary {completed}; "
                               f"exiting {self.exit_code}")
-                    tl.end_step("skipped" if skipped else "completed")
+                    tl.end_step("skipped" if skipped else
+                                ("reconfigured" if reconfigured_attempt
+                                 else "completed"))
                     raise SystemExit(self.exit_code)
                 if sentry is not None \
                         and completed % sentry.snapshot_every == 0:
@@ -463,15 +570,22 @@ class ResilientLoop:
                         and completed % self.save_every == 0 \
                         and completed < num_steps:
                     _commit(completed, resume_step=step)
-                tl.end_step("skipped" if skipped else "completed")
+                tl.end_step("skipped" if skipped else
+                            ("reconfigured" if reconfigured_attempt
+                             else "completed"))
                 step += 1
             if self.save_final and num_steps > start:
                 _commit(num_steps)
-            elif watchdog is not None:
-                watchdog.pause()
+            else:
+                if watchdog is not None:
+                    watchdog.pause()
+                if mesh_wd is not None:
+                    mesh_wd.pause()
         finally:
             if watchdog is not None:
                 watchdog.stop()
+            if mesh_wd is not None:
+                mesh_wd.stop()
             if self.compile_ledger is not None:
                 self.compile_ledger.detach()
             self._restore_handlers(saved_handlers)

@@ -28,6 +28,13 @@ ELASTIC_TTL = 60                 # node lease ttl seconds
 ELASTIC_EXIT_CODE = 101          # relaunch-needed exit code (reference :44)
 
 
+def health_prefix(job_id: str) -> str:
+    """Coordinator prefix the mesh watchdog publishes per-host health
+    under — a sibling of the manager's ``.../nodes/`` membership prefix,
+    same job namespace, so one coordinator carries both planes."""
+    return f"/paddle_tpu/elastic/{job_id}/health/"
+
+
 class ElasticLevel:
     FAULT_TOLERANCE = 1          # fixed np; rejoin under the same size
     ELASTIC = 2                  # np may move within [min_np, max_np]

@@ -67,6 +67,18 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--job_id", type=str,
                    default=os.environ.get("PADDLE_ELASTIC_JOB_ID", "default"),
                    help="elastic job id namespacing the coordinator")
+    p.add_argument("--elastic_timeout", type=float,
+                   default=float(os.environ.get(
+                       "PADDLE_ELASTIC_TIMEOUT", "0") or 0) or None,
+                   help="seconds membership may sit between min_np and "
+                        "max_np before launching anyway (default 120; "
+                        "chaos drills shrink it so a host kill settles "
+                        "in test time)")
+    p.add_argument("--lease_ttl", type=float,
+                   default=float(os.environ.get(
+                       "PADDLE_ELASTIC_LEASE_TTL", "0") or 0) or None,
+                   help="node lease ttl seconds (default 60; a dead "
+                        "host's membership lapses after this)")
     p.add_argument("--host", type=str,
                    default=os.environ.get("POD_IP"),
                    help="this node's address for elastic membership")
@@ -172,9 +184,14 @@ def _launch_elastic(args) -> int:
     host = args.host or socket.gethostname()
     curr = f"{host}:{args.start_port}"
     coord = FileCoordinator(args.elastic_coordinator)
+    mk = {}
+    if args.elastic_timeout is not None:
+        mk["elastic_timeout"] = args.elastic_timeout
+    if args.lease_ttl is not None:
+        mk["lease_ttl"] = args.lease_ttl
     manager = ElasticManager(coord, job_id=args.job_id,
                              np=args.np or str(args.nnodes),
-                             curr_host=curr)
+                             curr_host=curr, **mk)
     if args.max_restarts is not None:
         # 0 is a real request: a deterministic crash should error out,
         # not burn the default 3-fault budget

@@ -188,6 +188,34 @@ def chrome_trace(tracer) -> dict:
                             "ts": _us(target["t_start"]), "name": kind,
                             "cat": "link"})
             target = None                # arrows already emitted
+        elif kind == "reconfigured" and ev.get("origin_wall") is not None:
+            # cross-RESTART elastic resume: the pre-reconfiguration
+            # world's events died with its processes, so the link is
+            # WALL-anchored like `recovered` above — a synthetic
+            # instant at the restored generation's commit wall time
+            # flows into the first attempt on the new topology
+            target = tracer.spans.get(ev.get("span"))
+            if target is not None:
+                origin_ts = _us(ev["origin_wall"] - tracer.wall0)
+                flow_id += 1
+                out.append({"ph": "i", "s": "t", "pid": pid, "tid": tid,
+                            "ts": origin_ts,
+                            "name": "pre_reconfig_commit",
+                            "cat": "reconfigured",
+                            "args": {"origin_wall": ev["origin_wall"],
+                                     "from_world": ev.get("from_world"),
+                                     "to_world": ev.get("to_world")}})
+                out.append({"ph": "s", "id": flow_id, "pid": pid,
+                            "tid": tid, "ts": origin_ts,
+                            "name": kind, "cat": "link"})
+                out.append({"ph": "f", "bp": "e", "id": flow_id,
+                            "pid": tracks.pid(target["replica"]),
+                            "tid": tracks.tid(target["replica"],
+                                              target.get("slot"),
+                                              target.get("thread")),
+                            "ts": _us(target["t_start"]), "name": kind,
+                            "cat": "link"})
+            target = None                # arrows already emitted
         if target is not None:
             flow_id += 1
             out.append({"ph": "s", "id": flow_id, "pid": pid, "tid": tid,

@@ -56,8 +56,12 @@ __all__ = ["StepTimeline", "NullTimeline", "NULL_TIMELINE",
 #: States a step-attempt (root) span may legally end in.  Background
 #: phases recorded outside any step (e.g. the seed snapshot, the final
 #: checkpoint commit) are their own one-span traces ending ``finished``.
+#: ``reconfigured`` is a completion: the first attempt after an elastic
+#: topology-change resume ends in it (the step ran to the boundary; the
+#: marker says it ran on a DIFFERENT world than the checkpoint's).
 STEP_TERMINAL_STATES = frozenset({
-    "completed", "rolled_back", "skipped", "escalated", "finished"})
+    "completed", "rolled_back", "skipped", "escalated", "finished",
+    "reconfigured"})
 
 #: The canonical phase names the training loops emit.  ``phase()``
 #: accepts any string — these are documentation, not an allowlist.
@@ -106,6 +110,7 @@ class NullTimeline:
     on_skip = _noop
     on_rollback = _noop
     on_escalate = _noop
+    on_reconfigured = _noop
 
     def phase(self, _name: str):
         return _NULL_PHASE
@@ -193,6 +198,7 @@ class StepTimeline:
         self.steps_rolled_back = 0
         self.steps_skipped = 0
         self.escalations = 0
+        self.reconfigurations = 0
         self.phase_seconds: Dict[str, float] = {}
 
     # -- construction -------------------------------------------------------
@@ -303,7 +309,7 @@ class StepTimeline:
         self._event("step", trace=self._step_trace, span=self._step_span,
                     thread="step", step=self._step, state=state,
                     dt_ms=round(dt * 1e3, 3))
-        if state == "completed":
+        if state in ("completed", "reconfigured"):
             self.steps_completed += 1
         elif state == "skipped":
             self.steps_skipped += 1
@@ -407,6 +413,28 @@ class StepTimeline:
         self.escalations += 1
         self.end_step("escalated")
 
+    # -- elastic transitions ------------------------------------------------
+
+    def on_reconfigured(self, step: int,
+                        origin_wall: Optional[float] = None,
+                        **attrs) -> None:
+        """Mark the OPEN attempt as the first one after an elastic
+        topology-change resume (call between :meth:`begin_step` and
+        :meth:`end_step`; the loop then ends the attempt
+        ``"reconfigured"``).  ``origin_wall`` is the wall time of the
+        checkpoint generation the resume restored — the exporter
+        renders a wall-anchored synthetic instant at that moment plus a
+        flow arrow into this attempt, the cross-restart link (same
+        pattern as the crash-recovery ``pre_crash_admission``; the
+        restarted process's monotonic clock shares no origin with its
+        predecessor's, so only wall time can anchor the arrow)."""
+        self._event("reconfigured", trace=self._step_trace,
+                    span=self._step_span, thread="step", step=int(step),
+                    **({"origin_wall": float(origin_wall)}
+                       if origin_wall is not None else {}),
+                    **attrs)
+        self.reconfigurations += 1
+
     # -- introspection ------------------------------------------------------
 
     def counters(self) -> dict:
@@ -417,6 +445,7 @@ class StepTimeline:
             "rolled_back": self.steps_rolled_back,
             "skipped": self.steps_skipped,
             "escalations": self.escalations,
+            "reconfigured": self.reconfigurations,
             "events": len(self.events),
             "spans": len(self.spans),
             "dropped": self.dropped,

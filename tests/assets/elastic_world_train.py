@@ -1,0 +1,198 @@
+"""Elastic world-change drill worker (ISSUE 17).
+
+Runs under ``paddle_tpu.distributed.launch --elastic_coordinator`` on
+N hosts (one process per host).  Trains a tiny linear model data-parallel
+with an EXPLICIT cross-process gradient all-reduce (the stacked eager
+collective contract — each process contributes its row of a [W, ...]
+global array), so the training math is the global-batch mean gradient at
+every world size, and a dead peer makes the next collective fail loudly.
+The data schedule is an :class:`ElasticDataSchedule` — the global sample
+order is a pure function of the step, each rank takes a contiguous slice
+of the step window, and ``assert_coverage`` checks exactly-once at EVERY
+world size the job passes through.  Rank 0 commits an atomic pickle
+checkpoint (tmp + ``os.replace``) after every step carrying params,
+optimizer, losses, and the ``(start_step, stop_step, world)`` life
+segments; any relaunch resumes from it at whatever world size the
+elastic manager regenerated.
+
+The chaos half lives in the TEST (tests/test_elastic_reshard.py): it
+SIGKILLs one host's whole process group mid-run; this worker just has to
+survive its peer's death — the armed :class:`MeshWatchdog` wedged
+deadline (exit 101) and the launcher's membership watch both converge on
+a relaunch of the survivors at np−1.
+
+Env: PADDLE_TEST_CKPT_DIR (required), PADDLE_TEST_STEP_DIR (per-step
+marker files ``rank<r>_step<s>`` holding the launcher pid — the test's
+kill target), PADDLE_TEST_OUT (rank-0 final JSON), PADDLE_TEST_STEPS,
+PADDLE_TEST_HEALTH_DIR (arm MeshWatchdog through a FileCoordinator
+there), PADDLE_TEST_COLLECTIVE_TIMEOUT.
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if "," in os.environ.get("PADDLE_TRAINER_ENDPOINTS", ""):
+    # gloo needs the coordination service the launcher only wires up
+    # for a multi-process world; a world-1 round (and the solo oracle
+    # run) has no distributed client
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed.fault_tolerance import MeshWatchdog  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic.manager import (  # noqa: E402
+    FileCoordinator)
+from paddle_tpu.distributed.reshard import ElasticDataSchedule  # noqa: E402
+
+GLOBAL_BATCH = 16
+
+
+def main():
+    penv = paddle.distributed.init_parallel_env()
+    rank = penv.rank
+    world = max(penv.world_size, 1)
+
+    ckpt_dir = os.environ["PADDLE_TEST_CKPT_DIR"]
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt = os.path.join(ckpt_dir, "state.pdparams")
+    step_dir = os.environ.get("PADDLE_TEST_STEP_DIR")
+    if step_dir:
+        os.makedirs(step_dir, exist_ok=True)
+    num_steps = int(os.environ.get("PADDLE_TEST_STEPS", "8"))
+
+    # fixed global stream: step s consumes window [s*G, (s+1)*G) of a
+    # 16-sample linear-regression dataset (wrapping each step)
+    rs = np.random.RandomState(0)
+    X = rs.randn(GLOBAL_BATCH, 8).astype(np.float32)
+    Wt = rs.randn(8, 2).astype(np.float32)
+    Y = X @ Wt
+    sched = ElasticDataSchedule(GLOBAL_BATCH, dataset_size=GLOBAL_BATCH)
+
+    paddle.seed(0)
+    model = nn.Linear(8, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+
+    # cross-process DP grad sync via the stacked eager collectives (the
+    # multi-controller contract: each process supplies its row of a
+    # [W, ...] global array, all_reduce sums the rows).  Losses are
+    # sum/(G*out) so summed grads == the exact global-batch mean grad at
+    # every world size.
+    if world > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as JP
+
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.collective import Group, _world_group
+
+        g = _world_group()
+        stacked_sh = NamedSharding(g.mesh, JP(Group.AXIS))
+
+        def sync_grads():
+            for p in model.parameters():
+                local = np.asarray(p.grad.numpy())[None]
+                t = Tensor._wrap(jax.make_array_from_process_local_data(
+                    stacked_sh, local, (world,) + local.shape[1:]))
+                paddle.distributed.all_reduce(t)
+                summed = np.asarray(
+                    t._value().addressable_data(0))[0]
+                p.grad = jax.numpy.asarray(summed)  # write-through setter
+    else:
+        def sync_grads():
+            pass
+
+    start, losses, segments = 0, [], []
+    if os.path.exists(ckpt):
+        st = paddle.load(ckpt)
+        model.set_state_dict(st["model"])
+        opt.set_state_dict(st["opt"])
+        start = int(st["step"])
+        losses = list(st["losses"])
+        segments = [list(s) for s in st["segments"]]
+        print(f"[drill {rank}] resumed step {start} at world {world} "
+              f"(segments {segments})", file=sys.stderr, flush=True)
+    segments.append([start, start, world])
+
+    wd = None
+    health_dir = os.environ.get("PADDLE_TEST_HEALTH_DIR")
+    if health_dir:
+        wd = MeshWatchdog(
+            FileCoordinator(health_dir), job_id="drill",
+            host=os.environ.get("PADDLE_CURRENT_ENDPOINT", f"r{rank}"),
+            heartbeat_interval=0.25,
+            collective_timeout=float(
+                os.environ.get("PADDLE_TEST_COLLECTIVE_TIMEOUT", "20")))
+        wd.start()
+
+    def train_step(x, y):
+        # per-rank partial of the GLOBAL-batch mean loss: sum of squared
+        # errors over this rank's slice / (G * out); the summed grads
+        # after sync_grads() are the exact global mean-loss gradient
+        loss = ((model(x) - y) ** 2).sum() / float(GLOBAL_BATCH * 2)
+        loss.backward()
+        sync_grads()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # chaos pacing: keep the job alive long enough for the test to land
+    # its SIGKILL mid-run (0 for the oracle)
+    step_sleep = float(os.environ.get("PADDLE_TEST_STEP_SLEEP", "0"))
+
+    for step in range(start, num_steps):
+        if step_sleep:
+            time.sleep(step_sleep)
+        sched.assert_coverage(step, world)      # exactly-once, this world
+        idx = sched.local_indices(step, rank, world)
+        x = paddle.to_tensor(X[idx])
+        y = paddle.to_tensor(Y[idx])
+        try:
+            train_step(x, y)
+        except Exception as exc:   # peer died mid-collective: relaunch
+            print(f"[drill {rank}] step {step} collective failed "
+                  f"({type(exc).__name__}); exiting 101 for relaunch",
+                  file=sys.stderr, flush=True)
+            os._exit(101)
+        if wd is not None:
+            wd.notify(step)
+        # world-invariant loss record: evaluate the synced (replicated)
+        # params on the FULL global batch in host numpy — no collective,
+        # identical at every world size
+        wh = np.asarray(model.weight.numpy())
+        bh = np.asarray(model.bias.numpy())
+        lv = float((((X @ wh + bh) - Y) ** 2).mean())
+        losses.append(lv)
+        segments[-1][1] = step + 1
+        if rank == 0:
+            tmp = ckpt + ".tmp"
+            paddle.save({"model": model.state_dict(),
+                         "opt": opt.state_dict(), "step": step + 1,
+                         "losses": losses, "segments": segments}, tmp)
+            os.replace(tmp, ckpt)
+        if step_dir:
+            with open(os.path.join(step_dir,
+                                   f"rank{rank}_step{step}"), "w") as f:
+                f.write(str(os.getppid()))
+
+    if wd is not None:
+        wd.stop()
+    if rank == 0:
+        out = os.environ.get("PADDLE_TEST_OUT")
+        if out:
+            lost = sched.lost_samples([tuple(s) for s in segments])
+            with open(out, "w") as f:
+                json.dump({"losses": losses, "segments": segments,
+                           "lost_samples": lost, "final_world": world}, f)
+    print(f"[drill {rank}] done at world {world}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

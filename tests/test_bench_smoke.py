@@ -58,6 +58,16 @@ def test_smoke_mode_emits_json_line():
     assert out["train_tp_overlap_enabled"] == 1.0
     assert out["train_tp_overlap_exposed_collectives"] > 0
     assert len(out["train_tp_overlap_fingerprint"]) == 16
+    # elastic reconfiguration drill (ISSUE 17): the dp=4 → dp=2 resume
+    # actually resharded (bench.py exits nonzero unless the resharded
+    # state is bitwise identical to the committed generation, zero
+    # samples of the elastic schedule were lost or duplicated across
+    # the world change, and the post-resume steady state added zero
+    # compiles); the pinned fields put the reconfiguration price and
+    # the exactly-once audit on the one-JSON-line contract
+    assert out["train_elastic_reconfig_ms"] > 0
+    assert out["train_elastic_replayed_steps"] >= 1
+    assert out["train_elastic_lost_samples"] == 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,626 @@
+"""Elastic mesh reconfiguration (ISSUE 17): topology-change-safe resume.
+
+The acceptance bar:
+
+- a state resharded onto a NEW mesh by the load path is **bitwise
+  identical** (per-tensor sha256 over global bytes) to freshly sharding
+  the same global arrays at the new topology — params, optimizer
+  accumulators, RNG, GradScaler, and sentry state all covered;
+- the reshard report from ``load_state_dict`` is NOT silent: every
+  tensor's kept/dropped mesh axes are named;
+- the elastic data schedule repartitions the global sample stream at any
+  world size with zero lost and zero duplicated samples (host-side
+  assert, plus a whole-run audit across a world change);
+- a same-np rank-permutation resume is bitwise identical to an
+  uninterrupted run; a DP-degree change resumes at f32 loss parity with
+  zero steady-state compile misses after the post-resume rebuild;
+- the mesh health watchdog heartbeats through the coordinator duck,
+  flags stragglers off the published step-time EMAs, drops heartbeats
+  under ``elastic.heartbeat`` chaos, and escalates through the
+  crash-artifact path;
+- the real chaos drill: one of two launcher process groups is SIGKILLed
+  mid-run and the survivor relaunches at np−1 via the FileCoordinator,
+  resuming from the shared checkpoint with loss parity and exactly-once
+  sample accounting.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.rng import get_rng_state
+from paddle_tpu.distributed import checkpoint as ckpt, mesh as mesh_mod
+from paddle_tpu.distributed.fault_tolerance import (
+    FaultPlan, MeshWatchdog, ResilientLoop)
+from paddle_tpu.distributed.fleet.elastic.manager import (
+    FileCoordinator, InMemoryCoordinator)
+from paddle_tpu.distributed.reshard import (
+    ElasticDataSchedule, diff_digests, state_digests, tensor_digest,
+    verify_resharded, world_descriptor)
+from paddle_tpu.distributed.sharding_spec import shard_parameter
+from paddle_tpu.obs.compile_ledger import CompileLedger
+from paddle_tpu.obs.perfetto import chrome_trace
+from paddle_tpu.obs.train import (
+    StepTimeline, resolve_timeline, validate_timeline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRILL = os.path.join(REPO, "tests", "assets", "elastic_world_train.py")
+
+import jax  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    saved = mesh_mod.get_global_mesh()
+    mesh_mod.set_global_mesh(None)
+    yield
+    mesh_mod.set_global_mesh(saved)
+
+
+# -- digest proofs ---------------------------------------------------------
+
+class TestReshardDigests:
+    def test_resharded_state_bitwise_identical_across_topologies(
+            self, tmp_path):
+        """Save a full pack_state-shaped payload (sharded params +
+        optimizer-moment-like tensors + bf16 leaf + RNG + scaler +
+        sentry) under one mesh, reload it under a DIFFERENT mesh through
+        the template path: per-tensor digests must match the original
+        global arrays exactly."""
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.distributed.fault_tolerance import DivergenceSentry
+
+        m1 = mesh_mod.hybrid_mesh(dp=2, mp=4)
+        mesh_mod.set_global_mesh(m1)
+        rs = np.random.RandomState(0)
+        w = paddle.to_tensor(rs.randn(8, 16).astype(np.float32))
+        w.stop_gradient = False
+        shard_parameter(w, P(None, "model"), m1)
+        mom = paddle.to_tensor(rs.randn(8, 16).astype(np.float32))
+        mom.stop_gradient = False
+        shard_parameter(mom, P(None, "model"), m1)
+        bf = paddle.to_tensor(
+            np.linspace(-2, 2, 32).astype(np.float32)).astype("bfloat16")
+        scaler = GradScaler(init_loss_scaling=512.0)
+        sentry = DivergenceSentry(snapshot_every=4, ring_capacity=2)
+        state = {"user": {"w": w, "m": mom, "bf": bf},
+                 "@step": 3, "@rng": get_rng_state(),
+                 "@scaler": scaler.state_dict(),
+                 "@sentry": sentry.state_dict()}
+        want = state_digests(state)
+        path = str(tmp_path / "ck")
+        ckpt.save_state_dict(state, path)
+
+        # reload under the transposed topology
+        m2 = mesh_mod.hybrid_mesh(dp=4, mp=2)
+        mesh_mod.set_global_mesh(m2)
+        w2 = paddle.to_tensor(np.zeros((8, 16), np.float32))
+        w2.stop_gradient = False
+        shard_parameter(w2, P(None, "model"), m2)
+        m2t = paddle.to_tensor(np.zeros((8, 16), np.float32))
+        m2t.stop_gradient = False
+        shard_parameter(m2t, P(None, "model"), m2)
+        report = {}
+        loaded = ckpt.load_state_dict(
+            path, {"user": {"w": w2, "m": m2t, "bf": None},
+                   "@step": None, "@rng": None, "@scaler": None,
+                   "@sentry": None},
+            reshard_report=report)
+        # the resharded state lives on the NEW mesh...
+        lw = loaded["user"]["w"]._value()
+        assert dict(lw.sharding.mesh.shape)["data"] == 4
+        # ...and is bitwise identical to the original global arrays
+        got = verify_resharded(loaded, state)
+        assert got == want
+        # the report names every tensor's kept axes, nothing dropped
+        assert report["user/w"]["kept_axes"] == ["model"]
+        assert report["user/w"]["dropped_axes"] == []
+        assert any(k.startswith("@rng") for k in report), report.keys()
+
+        # negative control: a single flipped element must be caught
+        bad = {"user": {"w": loaded["user"]["w"],
+                        "m": paddle.to_tensor(
+                            np.asarray(loaded["user"]["m"].numpy()) + 1e-7),
+                        "bf": loaded["user"]["bf"]}}
+        assert diff_digests(state_digests(bad["user"]),
+                            state_digests(state["user"]))
+        with pytest.raises(ValueError, match="NOT bitwise identical"):
+            verify_resharded(bad["user"], state["user"])
+
+    def test_bf16_digest_is_bitwise_not_lossy(self):
+        a = paddle.to_tensor(
+            np.linspace(-1, 1, 16).astype(np.float32)).astype("bfloat16")
+        b = paddle.to_tensor(
+            (np.linspace(-1, 1, 16).astype(np.float32) * (1 + 1e-2))
+        ).astype("bfloat16")
+        assert tensor_digest(a) == tensor_digest(a)
+        assert tensor_digest(a) != tensor_digest(b)
+
+    def test_reshard_report_names_dropped_axes(self, tmp_path):
+        """Loading a model-sharded tensor onto a mesh WITHOUT that axis
+        must drop the axis loudly in the report, never silently."""
+        m1 = mesh_mod.hybrid_mesh(dp=2, mp=4)
+        mesh_mod.set_global_mesh(m1)
+        w = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+        w.stop_gradient = False
+        shard_parameter(w, P(None, "model"), m1)
+        b = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        path = str(tmp_path / "ck")
+        ckpt.save_state_dict({"w": w, "b": b}, path)
+
+        # destination mesh has no "model" axis at all
+        mesh_mod.set_global_mesh(mesh_mod.build_mesh({"data": 8}))
+        report = {}
+        loaded = ckpt.load_state_dict(path, reshard_report=report)
+        np.testing.assert_array_equal(
+            np.asarray(loaded["w"].numpy()),
+            np.arange(64, dtype=np.float32).reshape(8, 8))
+        assert report["w"]["dropped_axes"] == ["model"]
+        assert report["w"]["kept_axes"] == []
+        assert report["w"]["source"] == "saved_spec"
+        assert "b" in report          # every tensor reported, not just w
+
+
+# -- the elastic data schedule --------------------------------------------
+
+class TestElasticDataSchedule:
+    def test_exactly_once_at_every_world_size(self):
+        sched = ElasticDataSchedule(12)
+        for world in range(1, 7):
+            for step in (0, 1, 5):
+                sched.assert_coverage(step, world)
+                ids = np.concatenate([
+                    sched.local_indices(step, r, world)
+                    for r in range(world)])
+                lo, hi = sched.step_window(step)
+                np.testing.assert_array_equal(
+                    ids, np.arange(lo, hi, dtype=np.int64))
+
+    def test_world_change_loses_and_duplicates_nothing(self):
+        sched = ElasticDataSchedule(8, dataset_size=32)
+        # one life at world 4 (steps 0-3), relaunch at world 3 (3-6):
+        # committed segments tile the stream exactly
+        assert sched.lost_samples([(0, 3, 4), (3, 6, 3)]) == 0
+        # an overlap (replayed committed step) IS counted
+        assert sched.lost_samples([(0, 4, 4), (3, 6, 3)]) > 0
+        # a gap (lost step) IS counted
+        assert sched.lost_samples([(0, 2, 4), (3, 6, 3)]) > 0
+
+    def test_local_batch_gathers_this_ranks_slice(self):
+        data = np.arange(10, dtype=np.float32)
+        sched = ElasticDataSchedule(4, dataset_size=10)
+        got = sched.local_batch(3, rank=1, world=2, data=data)
+        # step 3 window = ids [12, 16) % 10 = [2,3,4,5]; rank 1 of 2
+        # takes the second half
+        np.testing.assert_array_equal(got, np.array([4.0, 5.0]))
+
+
+# -- ResilientLoop topology-change-safe resume ----------------------------
+
+def _rig(dp, mp, devices=None, seed=5):
+    """A tiny model-sharded training rig under a fresh global mesh.
+    Batches are keyed on the step alone, so any faithful resume
+    reproduces the loss stream."""
+    mesh = mesh_mod.hybrid_mesh(dp=dp, mp=mp, devices=devices)
+    mesh_mod.set_global_mesh(mesh)
+    paddle.seed(seed)
+    # pinned parameter names: optimizer state keys (name-derived) must
+    # match across the oracle/interrupted/resumed rig instances
+    net = nn.Linear(8, 4, weight_attr=paddle.ParamAttr(name="el_w"),
+                    bias_attr=paddle.ParamAttr(name="el_b"))
+    shard_parameter(net.weight, P(None, "model"), mesh)
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    losses = []
+
+    def step_fn(step):
+        rs = np.random.RandomState(1000 + step)
+        x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+
+    state_fn = lambda: {"model": net.state_dict(),     # noqa: E731
+                        "opt": opt.state_dict()}
+    restore_fn = lambda s: (net.set_state_dict(s["model"]),  # noqa: E731
+                            opt.set_state_dict(s["opt"]))
+    return {"net": net, "opt": opt, "step_fn": step_fn, "losses": losses,
+            "state_fn": state_fn, "restore_fn": restore_fn}
+
+
+def _final_digests(rig):
+    return state_digests({"model": rig["net"].state_dict(),
+                          "opt": rig["opt"].state_dict(),
+                          "rng": get_rng_state()})
+
+
+class TestReconfiguredResume:
+    def test_dp_change_resume_parity_digests_and_observability(
+            self, tmp_path):
+        """The tentpole: train at dp=4, die, relaunch at dp=2 — the
+        resumed state is bitwise the saved generation resharded onto the
+        new mesh, the loss stream continues at f32 parity, the compile
+        ledger sees zero steady-state misses after the post-resume
+        rebuild, and the reconfiguration is observable end to end
+        (counters, timeline, perfetto flow arrow, /metrics)."""
+        devs = jax.devices()
+        # oracle: uninterrupted dp=4 run
+        ref = _rig(dp=4, mp=2)
+        ResilientLoop(str(tmp_path / "ref"), ref["state_fn"],
+                      ref["restore_fn"], save_every=None,
+                      verbose=False).run(ref["step_fn"], 8)
+        mesh_mod.set_global_mesh(None)
+
+        # life 1 at dp=4: cadence saves, no final commit (the "kill")
+        root = str(tmp_path / "ck")
+        r1 = _rig(dp=4, mp=2)
+        loop1 = ResilientLoop(root, r1["state_fn"], r1["restore_fn"],
+                              save_every=2, save_final=False,
+                              verbose=False)
+        loop1.run(r1["step_fn"], 5)
+        assert ckpt.latest_valid(root)[0] == 4
+        # the committed generation's GLOBAL arrays = the reshard oracle
+        gen4 = ckpt.load_state_dict(ckpt.generation_dir(root, 4),
+                                    return_numpy=True)
+        assert dict(gen4["@world"])["mesh_data"] == 4
+        mesh_mod.set_global_mesh(None)
+
+        # life 2 at dp=2 over HALF the devices: resume reshards
+        r2 = _rig(dp=2, mp=2, devices=devs[:4])
+        probe = ResilientLoop(root, r2["state_fn"], r2["restore_fn"],
+                              verbose=False)
+        assert probe.resume() == 4
+        assert probe.reconfigs == 1
+        assert probe.last_reconfig_s is not None
+        assert probe.reshard_report["user/model/weight"]["kept_axes"] \
+            == ["model"]
+        # bitwise: restored-and-resharded state == the generation's
+        # global arrays; RNG restored exactly too
+        verify_resharded({"model": r2["net"].state_dict(),
+                          "opt": r2["opt"].state_dict()},
+                         gen4["user"])
+        verify_resharded({"rng": get_rng_state()},
+                         {"rng": gen4["@rng"]})
+
+        # run to completion with the observatory attached
+        tl = StepTimeline()
+        ledger = CompileLedger()
+        loop2 = ResilientLoop(root, r2["state_fn"], r2["restore_fn"],
+                              save_every=2, verbose=False, timeline=tl,
+                              compile_ledger=ledger)
+        loop2.run(r2["step_fn"], 8)
+        assert loop2.reconfigs == 1
+        # f32 loss parity from the resumed step onward
+        np.testing.assert_allclose(r2["losses"], ref["losses"][4:],
+                                   rtol=1e-4, atol=1e-6)
+        # a new mesh is a new program — but after the first post-resume
+        # step everything is rebuilt: ZERO steady-state misses
+        assert ledger.steady_state_misses == 0
+
+        # observability: timeline terminal state + counters
+        assert tl.counters()["reconfigured"] == 1
+        assert validate_timeline(tl) == []
+        states = [sp["state"] for sp in tl.spans.values()
+                  if sp["name"] == "step"]
+        assert "reconfigured" in states
+        # perfetto: wall-anchored cross-restart flow arrow
+        trace = chrome_trace(tl)
+        names = [e.get("name") for e in trace["traceEvents"]]
+        assert "pre_reconfig_commit" in names
+        links = [e for e in trace["traceEvents"]
+                 if e.get("name") == "reconfigured"
+                 and e.get("ph") in ("s", "f")]
+        assert {e["ph"] for e in links} == {"s", "f"}
+        # elastic counters ride train_stats() and the /metrics body
+        ela = loop2.train_stats()["elastic"]
+        assert ela["reconfigs"] == 1 and ela["last_reconfig_ms"] > 0
+        assert ela["resharded_tensors"] >= 2
+        from paddle_tpu import obs
+        text = obs.render_all_metrics()
+        assert "elastic_reconfigs" in text
+        assert "elastic_last_reconfig_ms" in text
+
+    def test_same_np_rank_permutation_resume_is_bitwise(self, tmp_path):
+        """Pure device-order permutation at the SAME world size: the
+        resumed run's final state must equal the uninterrupted run's
+        final state bitwise (and it is NOT counted as a reconfig — the
+        world descriptor is unchanged, placement is the load path's
+        job)."""
+        devs = jax.devices()
+        four = list(devs[:4])
+        ref = _rig(dp=2, mp=2, devices=four)
+        ResilientLoop(str(tmp_path / "ref"), ref["state_fn"],
+                      ref["restore_fn"], save_every=None,
+                      verbose=False).run(ref["step_fn"], 8)
+        want = _final_digests(ref)
+        mesh_mod.set_global_mesh(None)
+
+        root = str(tmp_path / "ck")
+        r1 = _rig(dp=2, mp=2, devices=four)
+        ResilientLoop(root, r1["state_fn"], r1["restore_fn"],
+                      save_every=2, save_final=False,
+                      verbose=False).run(r1["step_fn"], 5)
+        mesh_mod.set_global_mesh(None)
+
+        permuted = [four[2], four[0], four[3], four[1]]
+        r2 = _rig(dp=2, mp=2, devices=permuted)
+        loop2 = ResilientLoop(root, r2["state_fn"], r2["restore_fn"],
+                              save_every=2, verbose=False)
+        loop2.run(r2["step_fn"], 8)
+        assert loop2.reconfigs == 0       # same world, only placement
+        assert _final_digests(r2) == want
+        np.testing.assert_allclose(r2["losses"], ref["losses"][4:],
+                                   rtol=0, atol=0)
+
+
+# -- mesh health watchdog --------------------------------------------------
+
+class TestMeshWatchdog:
+    def _wd(self, coord, host, **kw):
+        kw.setdefault("heartbeat_interval", 30.0)   # beats driven by hand
+        kw.setdefault("hard_exit", False)
+        return MeshWatchdog(coord, "job0", host, **kw)
+
+    def test_heartbeat_publishes_health_records(self):
+        coord = InMemoryCoordinator()
+        a = self._wd(coord, "hostA").start()
+        b = self._wd(coord, "hostB").start()
+        try:
+            peers = a.peers()
+            assert set(peers) == {"hostA", "hostB"}
+            assert a.stats()["membership"] == 2
+            assert a.stats()["heartbeats"] >= 1
+        finally:
+            a.stop()
+            b.stop()
+        assert a.peers() == {}           # stop() deregisters
+
+    def test_heartbeat_fault_point_drops_beats(self):
+        plan = FaultPlan().add_train_fault("elastic.heartbeat",
+                                           at_step=2, times=2)
+        coord = InMemoryCoordinator()
+        wd = self._wd(coord, "hostA", fault_plan=plan).start()
+        try:
+            wd._publish()                # beat 2: dropped
+            wd._publish()                # beat 3: dropped
+            wd._publish()                # beat 4: delivered
+        finally:
+            wd.stop()
+        assert wd.heartbeats == 2        # start's beat + beat 4
+        assert wd.dropped_heartbeats == 2
+
+    def test_fault_points_parse_from_env(self):
+        plan = FaultPlan.from_env(env={
+            "PADDLE_TPU_FT_TRAIN_FAULTS":
+                "elastic.heartbeat@1x2,train.straggler@3:stall=0.01"})
+        kinds = sorted(r["kind"] for r in plan.train_faults)
+        assert kinds == ["heartbeat", "straggler"]
+        assert plan.train_faults[1]["stall"] == 0.01
+        assert plan.should_drop_heartbeat() is True    # beat 1
+        assert plan.should_drop_heartbeat() is True    # beat 2
+        assert plan.should_drop_heartbeat() is False   # beat 3
+
+    def test_straggler_fault_stalls_the_step(self):
+        plan = FaultPlan().add_train_fault("train.straggler", at_step=2,
+                                           times=1, stall=0.05)
+        t0 = time.monotonic()
+        plan.fire(1)
+        assert time.monotonic() - t0 < 0.04
+        t0 = time.monotonic()
+        plan.fire(2)
+        assert time.monotonic() - t0 >= 0.05
+        t0 = time.monotonic()
+        plan.fire(2)                     # once per step: replay is clean
+        assert time.monotonic() - t0 < 0.04
+
+    def test_straggler_ema_flags_and_escalates(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TRACE_DIR",
+                           str(tmp_path / "crash"))
+        coord = InMemoryCoordinator()
+        seen = []
+        fast = self._wd(coord, "fast", straggler_factor=3.0,
+                        straggler_patience=2)
+        fast2 = self._wd(coord, "fast2", straggler_factor=3.0,
+                         straggler_patience=2)
+        slow = self._wd(coord, "slow", straggler_factor=3.0,
+                        straggler_patience=2,
+                        on_escalate=seen.append)
+        for wd in (fast, fast2, slow):
+            wd._lease = coord.lease(wd.lease_ttl)
+        fast.ema_ms, fast2.ema_ms, slow.ema_ms = 5.0, 6.0, 50.0
+        fast._publish()                # fleet median 6ms; slow is >3x it
+        fast2._publish()
+        slow._publish()
+        fast._check_straggler()
+        assert fast.stragglers_flagged == 0
+        slow._check_straggler()
+        assert slow.stragglers_flagged == 1 and not slow.escalated
+        slow._check_straggler()                    # patience=2 reached
+        assert slow.escalated
+        assert "straggler" in slow.escalation_reason
+        assert seen and "straggler" in seen[0]
+        assert slow.stats()["stragglers_flagged"] == 2
+        # escalation persisted crash artifacts before (not) exiting
+        crash = str(tmp_path / "crash")
+        assert os.path.isdir(crash) and os.listdir(crash)
+
+    def test_wedged_collective_deadline_and_pause_discipline(self):
+        coord = InMemoryCoordinator()
+        wd = self._wd(coord, "hostA", collective_timeout=0.2).start()
+        try:
+            wd.notify(0)
+            wd.notify(1)                 # warmed: deadline live
+            time.sleep(0.9)
+            assert wd.step_watchdog.fired
+        finally:
+            wd.stop()
+        wd2 = self._wd(coord, "hostB", collective_timeout=0.2).start()
+        try:
+            wd2.notify(0)
+            wd2.notify(1)
+            wd2.pause()                  # checkpoint-commit discipline
+            time.sleep(0.9)
+            assert not wd2.step_watchdog.fired
+        finally:
+            wd2.stop()
+
+    def test_notify_builds_step_time_ema(self):
+        coord = InMemoryCoordinator()
+        wd = self._wd(coord, "hostA")
+        wd.notify(0)
+        assert wd.ema_ms is None          # one boundary: no interval yet
+        time.sleep(0.02)
+        wd.notify(1)
+        assert wd.ema_ms is not None and wd.ema_ms >= 10.0
+
+
+# -- timeline surface ------------------------------------------------------
+
+class TestTimelineReconfigured:
+    def test_reconfigured_attempt_validates_and_renders(self):
+        tl = StepTimeline()
+        tl.begin_step(4)
+        tl.on_reconfigured(4, origin_wall=tl.wall0 - 3.0,
+                           from_world={"mesh_data": 4},
+                           to_world={"mesh_data": 2}, reconfig_ms=12.5)
+        with tl.phase("step_dispatch"):
+            pass
+        tl.end_step("reconfigured")
+        tl.begin_step(5)
+        tl.end_step()
+        assert validate_timeline(tl) == []
+        c = tl.counters()
+        assert c["reconfigured"] == 1
+        assert c["steps_completed"] == 2   # a reconfigured attempt counts
+        trace = chrome_trace(tl)
+        evs = trace["traceEvents"]
+        pre = [e for e in evs if e.get("name") == "pre_reconfig_commit"]
+        assert pre and pre[0]["args"]["from_world"] == {"mesh_data": 4}
+        assert pre[0]["ts"] < 0            # wall-anchored BEFORE this life
+        assert {e["ph"] for e in evs
+                if e.get("name") == "reconfigured"
+                and e.get("cat") == "link"} == {"s", "f"}
+
+    def test_null_timeline_mirrors_the_hook(self):
+        resolve_timeline(None).on_reconfigured(0, origin_wall=1.0)
+
+
+# -- the real SIGKILL chaos drill -----------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _clean_env(extra):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "XLA_FLAGS", "JAX_"))}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+class TestElasticSigkillDrill:
+    def test_sigkill_host_shrinks_world_and_converges(self, tmp_path):
+        """Two launcher process groups under one FileCoordinator at
+        ``--np 1:2``; one is SIGKILLed mid-step.  The survivor's
+        membership watch sees the lease lapse, relaunches at np−1, and
+        the worker resumes from the shared checkpoint: final world 1,
+        zero lost/duplicated samples, loss stream at parity with an
+        uninterrupted single-process run."""
+        # oracle: the same asset solo, no launcher, no chaos
+        ref_out = str(tmp_path / "ref.json")
+        r = subprocess.run(
+            [sys.executable, DRILL],
+            env=_clean_env({
+                "PADDLE_TEST_CKPT_DIR": str(tmp_path / "ck_ref"),
+                "PADDLE_TEST_OUT": ref_out}),
+            capture_output=True, text=True, timeout=180)
+        assert r.returncode == 0, r.stderr[-2500:]
+        ref = json.load(open(ref_out))
+        assert ref["segments"] == [[0, 8, 1]]
+
+        coord = str(tmp_path / "coord")
+        step_dir = str(tmp_path / "steps")
+        out = str(tmp_path / "drill.json")
+        ports = sorted((_free_port(), _free_port()))
+        # pre-seed both node records so neither launcher solo-matches a
+        # world-1 round before its peer finishes booting
+        fc = FileCoordinator(coord)
+        for p in ports:
+            fc.put(f"/paddle_tpu/elastic/drill/nodes/127.0.0.1:{p}",
+                   f"127.0.0.1:{p}")
+        fc.close()
+        env = _clean_env({
+            "PADDLE_TEST_CKPT_DIR": str(tmp_path / "ck"),
+            "PADDLE_TEST_STEP_DIR": step_dir,
+            "PADDLE_TEST_OUT": out,
+            "PADDLE_TEST_HEALTH_DIR": coord,
+            "PADDLE_TEST_COLLECTIVE_TIMEOUT": "20",
+            "PADDLE_TEST_STEP_SLEEP": "0.35",
+        })
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--elastic_coordinator", coord,
+             "--np", "1:2", "--job_id", "drill", "--host", "127.0.0.1",
+             "--start_port", str(p), "--elastic_timeout", "2",
+             "--lease_ttl", "2", "--max_restarts", "2", DRILL],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True) for p in ports]
+        try:
+            # wait for BOTH ranks to make real progress at world 2, then
+            # SIGKILL rank 1's whole process group (launcher + worker)
+            marker = os.path.join(step_dir, "rank1_step3")
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline \
+                    and not os.path.exists(marker):
+                for pr in procs:
+                    if pr.poll() is not None:
+                        o, e = pr.communicate()
+                        pytest.fail(f"launcher died early rc="
+                                    f"{pr.returncode}\n{e[-2500:]}")
+                time.sleep(0.1)
+            assert os.path.exists(marker), "drill never reached step 3"
+            doomed_pid = int(open(marker).read())
+            assert doomed_pid in [pr.pid for pr in procs]
+            os.killpg(doomed_pid, signal.SIGKILL)
+            survivor = next(pr for pr in procs if pr.pid != doomed_pid)
+            so, se = survivor.communicate(timeout=180)
+            assert survivor.returncode == 0, \
+                f"survivor rc={survivor.returncode}\n{so[-1200:]}" \
+                f"\n{se[-2500:]}"
+        finally:
+            for pr in procs:
+                if pr.poll() is None:
+                    try:
+                        os.killpg(pr.pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+
+        res = json.load(open(out))
+        # the world actually shrank mid-run: 2 → 1, and the survivor
+        # finished the job at np−1
+        worlds = [seg[2] for seg in res["segments"] if seg[1] > seg[0]]
+        assert 2 in worlds, res["segments"]
+        assert worlds[-1] == 1 and res["final_world"] == 1
+        # exactly-once across the reconfiguration
+        assert res["lost_samples"] == 0
+        # loss continuity: full stream at parity with the oracle
+        assert len(res["losses"]) == len(ref["losses"]) == 8
+        np.testing.assert_allclose(res["losses"], ref["losses"],
+                                   rtol=2e-4, atol=1e-6)

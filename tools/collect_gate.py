@@ -160,6 +160,9 @@ TIER1_CRITICAL = {
     "tests/test_tp_overlap.py":
         "TP compute/collective overlap: chunked-schedule parity & "
         "exposed-collective pins",
+    "tests/test_elastic_reshard.py":
+        "elastic reconfiguration: resharded-resume bitwise proofs, "
+        "exactly-once data schedule, mesh watchdog & SIGKILL drill",
 }
 
 
