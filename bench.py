@@ -619,6 +619,107 @@ def _hot_swap_drill(model):
     }
 
 
+def _sharded_serving_drill_child():
+    """Child half of the sharded serving drill
+    (``--sharded-serving-drill``): on the 8-device virtual CPU mesh,
+    serve the same workload through a single-chip paged engine and a
+    model=2 tensor-parallel paged engine (``Engine(mesh=...)``), and
+    print one JSON line with greedy output parity, the sharded engine's
+    steady-state compile misses, and both decode throughputs."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import Engine, serving_mesh
+
+    def build():
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        return m
+
+    rs = np.random.RandomState(0)
+    lengths = [5, 13, 21, 34, 9, 17, 48, 3, 27, 11, 40, 6]
+    prompts = [rs.randint(0, 128, (L,)).tolist() for L in lengths]
+    kw = dict(num_slots=4, max_seq=64, min_bucket=8,
+              kv_layout="paged", block_size=8)
+
+    base = Engine(build(), **kw)
+    base.warmup()
+    want = base.generate(prompts, max_new_tokens=12)
+    base_tps = base.stats()["decode_tokens_per_sec"]
+
+    eng = Engine(build(), mesh=serving_mesh(2), **kw)
+    eng.warmup()
+    warm = eng.metrics.compile_misses
+    got = eng.generate(prompts, max_new_tokens=12)
+    st = eng.stats()
+    print(json.dumps({
+        "match": 1.0 if got == want else 0.0,
+        "steady_misses": eng.metrics.compile_misses - warm,
+        "sharded_tokens_per_sec": st["decode_tokens_per_sec"],
+        "baseline_tokens_per_sec": base_tps,
+        "mesh_shape": st["sharding"]["mesh_shape"],
+        "model_parallel": st["sharding"]["model_parallel"],
+        "engine_state": st["health"]["state"],
+    }))
+
+
+def _sharded_serving_drill():
+    """Tensor-parallel serving drill (ISSUE 18): run the 2-shard-vs-
+    single-chip comparison in a subprocess pinned to the virtual CPU
+    mesh (the parent may hold a single-device backend), and fail the
+    bench structured on any greedy output divergence or steady-state
+    compile miss.  The throughput pair is the honest CPU statement: two
+    host devices emulating one chip each price the per-layer TP
+    all-reduces in, so the sharded number trails the single-chip one
+    off-hardware — the tracked contract is bitwise parity at zero
+    steady-state recompiles per mesh shape."""
+    FAIL_METRIC = "serving_gpt_tiny_decode_tokens_per_sec"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    xla = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        env["XLA_FLAGS"] = \
+            (xla + " --xla_force_host_platform_device_count=8").strip()
+    env.pop("PADDLE_TPU_BENCH_SMOKE", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--sharded-serving-drill"],
+        capture_output=True, text=True, env=env, timeout=600)
+    if proc.returncode != 0:
+        fail_structured("sharded serving drill crashed: "
+                        + (proc.stderr or proc.stdout)[-800:],
+                        metric=FAIL_METRIC)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    if not lines:
+        fail_structured(f"sharded serving drill emitted no JSON: "
+                        f"{proc.stdout[-400:]!r}", metric=FAIL_METRIC)
+    d = json.loads(lines[-1])
+    if d["match"] != 1.0:
+        fail_structured(
+            "sharded greedy outputs diverge from the single-chip "
+            "engine", metric=FAIL_METRIC)
+    if d["steady_misses"]:
+        fail_structured(
+            f"sharded engine recompiled in steady state: "
+            f"{d['steady_misses']} misses", metric=FAIL_METRIC)
+    if d["engine_state"] != "active":
+        fail_structured(
+            f"sharded engine unhealthy after the drill: "
+            f"{d['engine_state']}", metric=FAIL_METRIC)
+    return {
+        "serving_sharded_tokens_per_sec": d["sharded_tokens_per_sec"],
+        "serving_sharded_mesh_shape": d["mesh_shape"],
+        "serving_sharded_vs_single_chip": round(
+            d["sharded_tokens_per_sec"]
+            / max(d["baseline_tokens_per_sec"], 1e-9), 4),
+    }
+
+
 def serving_main():
     """Serving smoke bench: continuous-batching decode throughput + TTFT
     on the tiny GPT config (ISSUE 3).  Same one-JSON-line contract as the
@@ -763,6 +864,9 @@ def serving_main():
     durability = _durability_drill(model)
     hot_swap = _hot_swap_drill(model)
 
+    # -- tensor-parallel sharded serving: 2-shard vs single-chip ---------
+    sharded = _sharded_serving_drill()
+
     def _p50_ttft_ms(reqs):
         ts = sorted(r.ttft_s for r in reqs)
         return round(ts[len(ts) // 2] * 1e3, 3)
@@ -841,6 +945,12 @@ def serving_main():
         # across the version epoch)
         **durability,
         **hot_swap,
+        # tensor-parallel sharded serving (ISSUE 18): bitwise greedy
+        # parity with the single-chip engine at zero steady-state
+        # recompiles enforced in a 2-shard subprocess drill; the
+        # throughput ratio prices the per-layer TP all-reduces on the
+        # emulated mesh (expect < 1 off-hardware)
+        **sharded,
     }))
 
 
@@ -1352,6 +1462,11 @@ if __name__ == "__main__":
         # child half of the elastic drill: dp=4 → dp=2 reconfigured
         # resume on the 8-device virtual CPU mesh the parent pinned
         _elastic_drill_child()
+        sys.exit(0)
+    if "--sharded-serving-drill" in sys.argv:
+        # child half of the sharded serving drill: model=2 TP engine vs
+        # single-chip on the 8-device virtual CPU mesh the parent pinned
+        _sharded_serving_drill_child()
         sys.exit(0)
     if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
         import jax

@@ -66,6 +66,13 @@ class DataParallel(Layer):
     over the "data" axis; under jit the XLA partitioner inserts the fused
     gradient all-reduce (replacing EagerReducer,
     distributed/collective/reducer.h:87).
+
+    Multi-controller (``jax.process_count() > 1``): parameters stay local
+    replicas, the forward passes inputs through untouched (each process
+    already holds its shard of the global batch), and
+    :meth:`sync_gradients` performs the explicit eager cross-process
+    grad sum after each backward — call it between ``loss.backward()``
+    and ``opt.step()``.
     """
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
@@ -73,6 +80,19 @@ class DataParallel(Layer):
                  group=None, mesh=None):
         super().__init__()
         self._layers = layers
+        # multi-controller mode (one process per host, eager training
+        # loop): parameters stay LOCAL replicas and gradients sync via
+        # an explicit eager all_reduce (:meth:`sync_gradients`) — the
+        # reference DDP layout.  Replicating params onto a global mesh
+        # here would make every ``p.grad.numpy()`` a cross-process
+        # gather (and break the eager optimizers, which need
+        # fully-addressable arrays).
+        self._multi_controller = jax.process_count() > 1
+        self._stacked_sharding = None          # lazy (needs world group)
+        if self._multi_controller:
+            self._mesh = mesh
+            self._data_axis = None
+            return
         self._mesh = mesh or mesh_mod.ensure_global_mesh()
         axis = "data" if "data" in self._mesh.shape else list(self._mesh.shape)[0]
         self._data_axis = axis
@@ -87,6 +107,10 @@ class DataParallel(Layer):
                 p._set_data(place_array(arr, self._mesh, P()))
 
     def forward(self, *inputs, **kwargs):
+        if self._multi_controller:
+            # each process runs its local replica on its local shard of
+            # the global batch; cross-process sync is sync_gradients()
+            return self._layers(*inputs, **kwargs)
         from .fleet.meta_parallel.tensor_parallel import shard_batch
         axes = (self._data_axis, "sharding")
         inputs = tuple(shard_batch(x, self._mesh, batch_axes=axes)
@@ -94,6 +118,43 @@ class DataParallel(Layer):
         kwargs = {k: shard_batch(v, self._mesh, batch_axes=axes)
                   for k, v in kwargs.items()}
         return self._layers(*inputs, **kwargs)
+
+    def sync_gradients(self):
+        """Cross-process gradient sum after ``loss.backward()`` — the
+        multi-controller half of DP (single-process: no-op, GSPMD's
+        fused all-reduce already did it inside the compiled backward).
+
+        The stacked eager collective contract
+        (tests/assets/elastic_world_train.py is the regression drill):
+        each process contributes its local grad as row ``rank`` of a
+        ``[world, ...]`` global array, ``all_reduce`` sums the rows via
+        the world group's shard_map psum, and the summed grad writes
+        back through the ``p.grad`` setter.  Callers scale the local
+        loss so that the cross-process SUM is the global-batch mean
+        gradient (sum over the local slice / global batch size); a dead
+        peer makes the collective raise — callers treat that as the
+        relaunch signal.
+        """
+        if not self._multi_controller:
+            return
+        import numpy as np
+
+        from .collective import Group, _world_group, all_reduce
+
+        if self._stacked_sharding is None:
+            g = _world_group()
+            self._stacked_sharding = NamedSharding(g.mesh, P(Group.AXIS))
+        world = jax.process_count()
+        for p in self._layers.parameters():
+            if p.grad is None:
+                continue
+            local = np.asarray(p.grad.numpy())[None]
+            t = Tensor._wrap(jax.make_array_from_process_local_data(
+                self._stacked_sharding, local,
+                (world,) + local.shape[1:]))
+            all_reduce(t)
+            summed = np.asarray(t._value().addressable_data(0))[0]
+            p.grad = jnp.asarray(summed)     # write-through setter
 
     # reference API surface ------------------------------------------------
     def scale_loss(self, loss):

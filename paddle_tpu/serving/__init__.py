@@ -88,6 +88,9 @@ from .engine import (  # noqa: F401
     PRIORITY_LOW, PRIORITY_NORMAL, PRIORITY_HIGH,
 )
 from .spec_decode import SpecConfig, SpecState  # noqa: F401
+from .sharding import (  # noqa: F401
+    ServingShard, mesh_shape_key, serving_mesh,
+)
 from .router import Fleet, FleetRequest  # noqa: F401
 
 __all__ = ["KVCache", "CacheContext", "Engine", "Request",
@@ -101,4 +104,5 @@ __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "RequestTracer", "NullTracer", "NULL_TRACER",
            "FlightRecorder", "validate_trace",
            "RequestJournal", "JournalCorrupt",
-           "SpecConfig", "SpecState"]
+           "SpecConfig", "SpecState",
+           "ServingShard", "serving_mesh", "mesh_shape_key"]

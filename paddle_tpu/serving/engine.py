@@ -392,7 +392,8 @@ class Engine:
                  flight_recorder_steps: int = 256,
                  journal=None,
                  model_version: int = 0,
-                 speculation=None):
+                 speculation=None,
+                 mesh=None):
         cfg = getattr(model, "config", None)
         if cfg is None:
             raise TypeError("Engine needs a model carrying a .config "
@@ -495,6 +496,24 @@ class Engine:
 
             self.spec = SpecState(self, speculation)
             self.metrics.spec_cb = self.spec.snapshot
+        # tensor-parallel sharded serving (docs/SERVING.md "Sharded
+        # serving"): weights shard over the `model` mesh axis via their
+        # Megatron-TP specs, the KV pool by kv_heads (GQA groups stay
+        # shard-local), the sampler lanes / block tables / lengths
+        # replicate — one logical decision stream drives all shards.
+        # None keeps today's single-chip engine byte for byte.
+        self.shard = None
+        if mesh is not None:
+            from .sharding import ServingShard
+
+            self.shard = ServingShard(
+                mesh, kv_heads=kv_heads,
+                num_heads=cfg.num_attention_heads)
+            self.shard.place_model(self.model)
+            self.shard.place_state(self)
+        #: mesh-shape key ("model=2") journaled per admission and
+        #: validated by recover() — None for an unsharded engine
+        self.mesh_shape = self.shard.key if self.shard else None
         self._req_counter = itertools.count()
         self._prefill_fn = None
         self._decode_fn = None
@@ -698,11 +717,22 @@ class Engine:
     def _call_counted(self, fn, *args):
         """Run a compiled step, feeding the executable cache's own state
         into the hit/miss counters (a new program in the cache == one XLA
-        compile == one miss)."""
+        compile == one miss).
+
+        This is the single choke point every compiled call (warmup AND
+        serving) passes through, so it is also where a sharded engine
+        installs its mesh as the global mesh: the model forwards'
+        ``mark_sharding`` and the TP layers read it during tracing, and
+        the save/restore keeps co-resident engines (fleet shard groups
+        on disjoint device subsets) from seeing each other's mesh."""
+        from contextlib import nullcontext
+
         from ..core.autograd import no_grad
 
+        mesh_ctx = (self.shard.context() if self.shard is not None
+                    else nullcontext())
         before = len(fn.program_cache)
-        with no_grad():
+        with mesh_ctx, no_grad():
             out = fn(*args)
         self.metrics.on_compile(miss=len(fn.program_cache) > before)
         return out
@@ -979,7 +1009,8 @@ class Engine:
                     max_new_tokens=req.max_new_tokens,
                     eos_token_id=req.eos_token_id, engine=self.name,
                     model_version=self.model_version,
-                    recovered=req.recovered)
+                    recovered=req.recovered,
+                    mesh_shape=self.mesh_shape)
             except Exception as e:       # noqa: BLE001 — storage failure
                 req.journal_id = None    # nothing durable to audit
                 self._reject(req, f"journal admission write failed: "
@@ -1025,6 +1056,11 @@ class Engine:
         self.sampler.reset()             # warmup scribbled slot 0's lanes
         if self.spec is not None:
             self.spec.reset()
+        if self.shard is not None:
+            # the resets replaced the device arrays with fresh host
+            # zeros — re-pin them to the mesh so serving's first step
+            # sees the same shardings the warmup programs compiled for
+            self.shard.place_state(self)
         return {"buckets": use,
                 "programs": [name for name, _ in self._warmers],
                 "compile_misses": self.metrics.compile_misses}
@@ -2054,6 +2090,22 @@ class Engine:
         saved_max_queue, self.max_queue = self.max_queue, None
         try:
             for jid, rec in journal.pending().items():
+                # bitwise replay assumes the SAME mesh shape: a request
+                # admitted sharded carries its mesh-shape key, and a
+                # recovering engine of a different shape must fail that
+                # replay finally rather than serve it on a topology the
+                # journal never promised (device identities are not part
+                # of the key — any mesh of the same shape replays)
+                want = rec.get("mesh_shape")
+                if want != self.mesh_shape:
+                    journal.record_end(
+                        jid, "failed", final=True,
+                        error=f"recovery replay rejected: journaled "
+                              f"mesh shape {want!r} != this engine's "
+                              f"{self.mesh_shape!r}",
+                        engine=self.name)
+                    invalid.append(jid)
+                    continue
                 s = journal.replay_sampling(rec)
                 journal.begin_attempt(jid, recovered=True,
                                       origin_wall=rec.get("wall"))
@@ -2114,6 +2166,12 @@ class Engine:
                 "serve a torn response")
         sd = _resolve_weights(state_or_path)
         _write_state_dict(self.model, sd)
+        if self.shard is not None:
+            # set_state_dict's _set_data write-through landed host
+            # arrays in the parameter buffers — re-place them under
+            # their TP specs so the warmed executables keep their
+            # shardings (same specs as at construction: no new keys)
+            self.shard.place_model(self.model)
         return self._mark_weights_swapped(version)
 
     def _mark_weights_swapped(self, version: Optional[int] = None) -> int:
@@ -2211,6 +2269,9 @@ class Engine:
         self.metrics._slots_busy = len(self.running)
         self.metrics.queue_depth = len(self.queue)
         snap = self.metrics.snapshot()
+        if self.shard is not None:
+            snap["sharding"] = {"mesh_shape": self.mesh_shape,
+                                "model_parallel": self.shard.mp}
         if self.journal is not None:
             snap["durability"]["journal"] = self.journal.stats()
         if self.sanitizer is not None:

@@ -310,13 +310,21 @@ class RequestJournal:
                          max_new_tokens: int,
                          eos_token_id: Optional[int], engine: str,
                          model_version: int,
-                         recovered: bool = False) -> None:
+                         recovered: bool = False,
+                         mesh_shape: Optional[str] = None) -> None:
         """The replay recipe: everything a fresh process needs to
         re-admit this request bitwise (``seed_effective`` is the seed
         ``Engine._seed_for`` resolved at THIS admission, so an unseeded
-        temperature request replays the same stream it was drawing)."""
+        temperature request replays the same stream it was drawing).
+
+        ``mesh_shape`` is the sharded engine's mesh-shape key
+        (``"model=2"``) — recorded only when set, so unsharded journals
+        are byte-identical to pre-sharding ones, and recovery can refuse
+        to replay a sharded admission onto a different topology."""
         s = dict(sampling)
+        extra = {} if mesh_shape is None else {"mesh_shape": mesh_shape}
         self._append({
+            **extra,
             "kind": "admit", "jid": jid, "wall": round(time.time(), 6),
             "prompt_ids": [int(t) for t in prompt_ids],
             # plain-python coercion: numpy scalars are not JSON

@@ -216,10 +216,20 @@ class Fleet:
             reconstructible as ``type(model)(model.config)``, else
             share (where ``update_weights`` degrades to a
             stop-the-world swap).
+        shards_per_group: tensor-parallel width of each replica.  With
+            ``shards_per_group > 1`` every replica is a shard *group* —
+            an ``Engine(mesh=...)`` over its own DISJOINT slice of
+            ``jax.devices()`` — and the existing per-replica mechanisms
+            become the per-group ones the sharded deployment needs:
+            prefix-affinity dispatch targets a group, ``update_weights``
+            rolls one drained group at a time (per-shard ``_set_data``
+            write-through, one prefix-epoch bump per group), and
+            recovery replays bitwise onto any mesh of the same shape —
+            see docs/SERVING.md "Sharded serving".
         **engine_kwargs: forwarded to every replica's ``Engine(...)``
             (``num_slots``, ``max_seq``, ``kv_layout``, ...).  ``name``,
-            ``fault_plan``, ``tracer``, ``journal`` and
-            ``model_version`` are fleet-managed and rejected here.
+            ``fault_plan``, ``tracer``, ``journal``, ``model_version``
+            and ``mesh`` are fleet-managed and rejected here.
     """
 
     def __init__(self, model_or_config, *, num_replicas: int = 2,
@@ -228,10 +238,14 @@ class Fleet:
                  name: Optional[str] = None, fault_plan=None,
                  tracer=None, journal=None,
                  isolate_weights: Optional[bool] = None,
+                 shards_per_group: int = 1,
                  **engine_kwargs):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
+        if shards_per_group < 1:
+            raise ValueError(f"shards_per_group must be >= 1, "
+                             f"got {shards_per_group}")
         if max_redispatch < 0:
             raise ValueError("max_redispatch must be >= 0")
         if eject_after_failures < 1:
@@ -239,10 +253,36 @@ class Fleet:
         if supervise_every < 1:
             raise ValueError("supervise_every must be >= 1")
         for k in ("name", "fault_plan", "tracer", "journal",
-                  "model_version"):
+                  "model_version", "mesh"):
             if k in engine_kwargs:
                 raise ValueError(f"{k!r} is fleet-managed; pass it to "
                                  "Fleet, not through engine kwargs")
+        # shard groups (docs/SERVING.md "Sharded serving"): one replica
+        # == one shard GROUP — a tensor-parallel engine on its own
+        # DISJOINT device slice, so every fleet mechanism built for
+        # replicas (prefix-affinity dispatch, per-replica drain in a
+        # rolling update_weights, ejection/rebuild, journal recovery)
+        # applies to shard groups without a line of new control flow.
+        self.shards_per_group = int(shards_per_group)
+        if self.shards_per_group > 1:
+            import jax
+
+            from .sharding import serving_mesh
+
+            devs = jax.devices()
+            need = num_replicas * self.shards_per_group
+            if len(devs) < need:
+                raise ValueError(
+                    f"shards_per_group={self.shards_per_group} with "
+                    f"num_replicas={num_replicas} needs {need} devices "
+                    f"(disjoint per-group meshes), have {len(devs)}")
+            self._group_meshes: List[Optional[object]] = [
+                serving_mesh(self.shards_per_group,
+                             devices=devs[k * self.shards_per_group:
+                                          (k + 1) * self.shards_per_group])
+                for k in range(num_replicas)]
+        else:
+            self._group_meshes = [None] * num_replicas
         self.model = Engine.resolve_model(model_or_config)
         #: current fleet-wide weight version (bumped by update_weights;
         #: replicas join rolls — and rebuilds — at this version)
@@ -346,6 +386,7 @@ class Fleet:
                       fault_plan=self.fault_plan.scoped(index),
                       tracer=self.tracer, journal=self.journal,
                       model_version=self.model_version,
+                      mesh=self._group_meshes[index],
                       **self._engine_kwargs)
 
     def warmup(self) -> dict:
@@ -1126,6 +1167,7 @@ class Fleet:
                 "slots_total": eng.num_slots,
                 "occupancy": round(m.occupancy(), 4),
                 "compile_misses": m.compile_misses,
+                "mesh_shape": eng.mesh_shape,
                 "preemptions": m.requests_preempted,
                 "shed": m.requests_shed,
                 # the rebuild record's post-mortem attachment: a summary

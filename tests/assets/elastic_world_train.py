@@ -82,32 +82,14 @@ def main():
     opt = paddle.optimizer.SGD(learning_rate=0.05,
                                parameters=model.parameters())
 
-    # cross-process DP grad sync via the stacked eager collectives (the
-    # multi-controller contract: each process supplies its row of a
-    # [W, ...] global array, all_reduce sums the rows).  Losses are
+    # cross-process DP grad sync now lives IN DataParallel (the stacked
+    # eager collective contract this asset used to open-code: each
+    # process supplies its row of a [W, ...] global array, all_reduce
+    # sums the rows, the sum writes back through the p.grad setter).
+    # This drill is the regression test for that contract.  Losses are
     # sum/(G*out) so summed grads == the exact global-batch mean grad at
-    # every world size.
-    if world > 1:
-        from jax.sharding import NamedSharding, PartitionSpec as JP
-
-        from paddle_tpu.core.tensor import Tensor
-        from paddle_tpu.distributed.collective import Group, _world_group
-
-        g = _world_group()
-        stacked_sh = NamedSharding(g.mesh, JP(Group.AXIS))
-
-        def sync_grads():
-            for p in model.parameters():
-                local = np.asarray(p.grad.numpy())[None]
-                t = Tensor._wrap(jax.make_array_from_process_local_data(
-                    stacked_sh, local, (world,) + local.shape[1:]))
-                paddle.distributed.all_reduce(t)
-                summed = np.asarray(
-                    t._value().addressable_data(0))[0]
-                p.grad = jax.numpy.asarray(summed)  # write-through setter
-    else:
-        def sync_grads():
-            pass
+    # every world size; sync_gradients() is a no-op at world 1.
+    dp = paddle.DataParallel(model)
 
     start, losses, segments = 0, [], []
     if os.path.exists(ckpt):
@@ -136,9 +118,9 @@ def main():
         # per-rank partial of the GLOBAL-batch mean loss: sum of squared
         # errors over this rank's slice / (G * out); the summed grads
         # after sync_grads() are the exact global mean-loss gradient
-        loss = ((model(x) - y) ** 2).sum() / float(GLOBAL_BATCH * 2)
+        loss = ((dp(x) - y) ** 2).sum() / float(GLOBAL_BATCH * 2)
         loss.backward()
-        sync_grads()
+        dp.sync_gradients()
         opt.step()
         opt.clear_grad()
         return loss

@@ -158,6 +158,16 @@ def test_serving_mode_emits_json_line():
     assert out["serving_hot_swap_stall_ms"] >= 0
     assert out["serving_hot_swap_roll_ms"] > 0
     assert out["serving_hot_swap_model_version"] == 1
+    # tensor-parallel sharded serving (ISSUE 18): the 2-shard drill ran
+    # on the virtual mesh with greedy outputs bitwise equal to the
+    # single-chip engine at zero steady-state recompiles (bench fails
+    # structured otherwise); the throughput and its ratio to the
+    # single-chip baseline ride the one-JSON-line contract (the ratio
+    # prices the per-layer TP all-reduces — no ordering pinned on CPU,
+    # where two host devices emulate one chip each)
+    assert out["serving_sharded_tokens_per_sec"] > 0
+    assert out["serving_sharded_mesh_shape"] == "model=2"
+    assert out["serving_sharded_vs_single_chip"] > 0
 
 
 def test_preflight_failure_is_structured():

@@ -355,6 +355,7 @@ class TestHybridTrainStep:
         np.testing.assert_allclose(ya.numpy(), yb.numpy(), atol=1e-6)
         ya.mean().backward()
         yb.mean().backward()
+        dp.sync_gradients()   # single-process: must be a no-op
         np.testing.assert_allclose(model_a.weight.grad.numpy(),
                                    model_b.weight.grad.numpy(), atol=1e-6)
 

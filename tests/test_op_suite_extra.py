@@ -558,6 +558,15 @@ def _np_scatter_rows(x, rows, upd):
 _IDS2 = [s.name for s in SPECS2]
 assert len(set(_IDS2)) == len(_IDS2), "duplicate op enrollment"
 
+#: Grad checks whose finite-difference sweeps dominate this file's
+#: tier-1 wall time (the top four alone are ~29s of its ~86s on the
+#: budget box; the next five are ~1s each).  Forward/dtype/method
+#: coverage for these ops stays in tier-1; only the redundant heavy
+#: grad sweep moves behind ``-m slow`` (TestOpSuiteExtraSlowGrads).
+_SLOW_GRADS = {"fused_linear_ce", "grid_sample", "logcumsumexp", "sdpa",
+               "layer_norm_f", "npair_loss", "cosine_embedding_loss",
+               "triplet_margin_loss", "group_norm_f"}
+
 
 @pytest.mark.parametrize("spec", SPECS2, ids=_IDS2)
 class TestOpSuiteExtra:
@@ -565,6 +574,9 @@ class TestOpSuiteExtra:
         check_output(spec)
 
     def test_grad(self, spec):
+        if spec.name in _SLOW_GRADS:
+            pytest.skip("heavy grad sweep runs slow-marked in "
+                        "TestOpSuiteExtraSlowGrads")
         if spec.grad:
             check_grad(spec)
 
@@ -573,3 +585,16 @@ class TestOpSuiteExtra:
 
     def test_method_binding(self, spec):
         check_method(spec)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "spec", [s for s in SPECS2 if s.name in _SLOW_GRADS],
+    ids=[s.name for s in SPECS2 if s.name in _SLOW_GRADS])
+class TestOpSuiteExtraSlowGrads:
+    """The slowest grad sweeps (``_SLOW_GRADS``), deselected from
+    tier-1 (ISSUE 18 budget headroom) — run with ``-m slow``."""
+
+    def test_grad(self, spec):
+        assert spec.grad, "slow-grad enrollment for a grad=False op"
+        check_grad(spec)

@@ -163,6 +163,9 @@ TIER1_CRITICAL = {
     "tests/test_elastic_reshard.py":
         "elastic reconfiguration: resharded-resume bitwise proofs, "
         "exactly-once data schedule, mesh watchdog & SIGKILL drill",
+    "tests/test_sharded_serving.py":
+        "tensor-parallel serving: sharded-vs-single-chip bitwise "
+        "parity, mesh-shape recovery contract & shard-group hot swap",
 }
 
 

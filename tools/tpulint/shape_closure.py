@@ -62,6 +62,16 @@ CANONICAL = {
     # index and the per-slot emission caps are argument VALUES)
     "spec_draft": "gpt:tiny",
     "spec_k": 4,
+    # sharded section (ISSUE 18): per-mesh-shape key sets for
+    # Engine(mesh=serving_mesh(mp)).  Cache keys exclude sharding
+    # (shape/dtype/stop_gradient only), so each section must be the
+    # SAME closed set — build_manifest enumerates under each mesh and
+    # raises if a single key differs from the unsharded enumeration.
+    # model=1 is deliberately NOT enumerated: a size-1 axis filters out
+    # of every placement spec, so it is bitwise the unsharded engine
+    # (tests/test_sharded_serving.py proves that end to end) and
+    # enumerating it would double this pass to prove a tautology.
+    "serving_mesh_shapes": [2],
 }
 
 
@@ -119,11 +129,11 @@ def _out_shapes(prog) -> List[List]:
             for o in outs]
 
 
-def _build_engine(kv_layout: str, cfg: dict):
+def _build_engine(kv_layout: str, cfg: dict, mesh=None):
     from paddle_tpu.serving import Engine, SpecConfig
 
     kwargs = dict(num_slots=cfg["num_slots"], max_seq=cfg["max_seq"],
-                  min_bucket=cfg["min_bucket"])
+                  min_bucket=cfg["min_bucket"], mesh=mesh)
     if kv_layout in ("paged", "speculative"):
         kwargs.update(kv_layout="paged", block_size=cfg["block_size"])
     if kv_layout == "speculative":
@@ -191,16 +201,23 @@ def _verify_args(eng, *, n_active: int = 0, cap: int = 1):
     return _decode_args(eng, n_active=n_active) + [to_tensor(caps)]
 
 
-def enumerate_config(kv_layout: str, cfg: dict) -> Tuple[dict, dict]:
+def enumerate_config(kv_layout: str, cfg: dict,
+                     mesh=None) -> Tuple[dict, dict]:
     """Build every program the config admits; returns
     ``(manifest_section, key_index)`` where ``key_index`` maps each raw
-    cache key to its entry name (for the closure probe)."""
+    cache key to its entry name (for the closure probe).  With ``mesh``,
+    the engine is sharded and tracing runs under its mesh context — the
+    exact programs a sharded engine builds (still zero XLA compiles)."""
+    from contextlib import nullcontext
+
     from paddle_tpu.core.autograd import no_grad
 
-    eng = _build_engine(kv_layout, cfg)
+    eng = _build_engine(kv_layout, cfg, mesh=mesh)
     entries: Dict[str, dict] = {}
     key_index: Dict[tuple, str] = {}
-    with no_grad():
+    mesh_ctx = eng.shard.context() if eng.shard is not None \
+        else nullcontext()
+    with mesh_ctx, no_grad():
         plan = [(f"prefill[b={b}]", eng._prefill_fn, _prefill_args(eng, b))
                 for b in eng.buckets]
         if eng.spec is None:
@@ -342,6 +359,32 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
             "escapes": 0,
         }
         configs[layout] = section
+    # sharded sections (ISSUE 18): re-enumerate the plain layouts under
+    # each canonical serving mesh shape.  The cache key excludes
+    # sharding, so every section must be the SAME closed key set — any
+    # difference means a sharded engine would compile keys the
+    # manifest never proved closed, and is raised here, not recorded.
+    sharded = {}
+    for mp in cfg.get("serving_mesh_shapes", []):
+        from paddle_tpu.serving import mesh_shape_key, serving_mesh
+
+        mesh = serving_mesh(mp)
+        mkey = mesh_shape_key(mesh)
+        layouts = {}
+        for layout in ("contiguous", "paged"):
+            section, (eng, key_index) = enumerate_config(
+                layout, cfg, mesh=mesh)
+            want = configs[layout]["entries"]
+            got = section["entries"]
+            if {n: e["key_sha256"] for n, e in got.items()} != \
+                    {n: e["key_sha256"] for n, e in want.items()}:
+                raise AssertionError(
+                    f"sharded {layout} @ {mkey}: compiled-key set "
+                    "differs from the unsharded enumeration — sharding "
+                    "must never widen the key space")
+            layouts[layout] = {"programs": section["programs"],
+                               "keys_equal_unsharded": True}
+        sharded[mkey] = layouts
     # fleet replicas serve the plain layouts (speculation is a per-
     # engine opt-in, not a fleet default): the multiplication note
     # covers contiguous + paged only
@@ -361,6 +404,14 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
         "version": 1,
         "model": cfg["model"],
         "configs": configs,
+        "sharded": {
+            "note": "Engine(mesh=serving_mesh(mp)) key sets per mesh "
+                    "shape: cache keys exclude sharding, so each "
+                    "section is the SAME closed set the configs above "
+                    "prove — one warmed executable set per mesh shape, "
+                    "zero steady-state recompiles sharded",
+            "mesh_shapes": sharded,
+        },
         "fleet": {
             "replicas": cfg["fleet_replicas"],
             "programs_per_replica": per_replica,
@@ -411,7 +462,7 @@ def diff_manifests(committed: dict, fresh: dict) -> List[str]:
                        if old_sec.get(k) != new_sec.get(k)]
             problems.append(f"{layout}: config section drifted "
                             f"({', '.join(changed)})")
-    for field in ("version", "model", "fleet"):
+    for field in ("version", "model", "sharded", "fleet"):
         if committed.get(field) != fresh.get(field):
             problems.append(
                 f"{field}: committed {committed.get(field)!r} != fresh "
@@ -452,6 +503,13 @@ def main(argv=None) -> int:
             return 2
         i += 1
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the sharded sections need a multi-device host platform; the flag
+    # only takes effect BEFORE the (lazy) jax import inside
+    # build_manifest, which is why main() sets it, not the library
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     fresh = build_manifest()
     n_keys = sum(s["programs"] for s in fresh["configs"].values())
     if write:
