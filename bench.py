@@ -720,6 +720,202 @@ def _sharded_serving_drill():
     }
 
 
+def _degraded_serving_serve_child():
+    """Serve half of the kill-a-shard drill
+    (``--degraded-serving-serve-child <journal_dir>``): a model=2
+    tensor-parallel engine journals live STREAMING traffic on the
+    8-device virtual CPU mesh, then SIGKILLs its own process mid-decode
+    — the honest stand-in for a shard host dying under load."""
+    import signal
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import (
+        Engine, RequestJournal, SamplingParams, serving_mesh,
+    )
+
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    eng = Engine(m, mesh=serving_mesh(2), num_slots=2, max_seq=32,
+                 min_bucket=8, journal=RequestJournal(sys.argv[-1]))
+    eng.warmup()
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, 128, (L,)).tolist() for L in (6, 11, 14)]
+    streamed = []
+    eng.add_request(prompts[0], max_new_tokens=8,
+                    stream_cb=lambda r, t: streamed.append(t))
+    eng.add_request(prompts[1], max_new_tokens=8,
+                    sampling=SamplingParams(temperature=0.7, top_k=8,
+                                            seed=99),
+                    stream_cb=lambda r, t: streamed.append(t))
+    eng.add_request(prompts[2], max_new_tokens=8,
+                    stream_cb=lambda r, t: streamed.append(t))
+    steps = 0
+    while eng.step():
+        steps += 1
+        if steps == 3:              # mid-decode, tokens already streamed
+            print(f"STREAMED {len(streamed)}", flush=True)
+            print("KILLING", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+    raise SystemExit("unreachable: the SIGKILL must land mid-drill")
+
+
+def _degraded_serving_recover_child():
+    """Recovery half of the kill-a-shard drill
+    (``--degraded-serving-recover-child <journal_dir>``): the SIGKILL'd
+    host took mesh device 1 with it — carve the largest viable mp' on
+    the SURVIVING device (``degrade_step``), replay the journal
+    cross-mesh onto the rebuilt group, and print one JSON line with the
+    bitwise verdict against an uninterrupted oracle run at the degraded
+    shape, the rebuild+replay wall time, and the exactly-once audit."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import (
+        Engine, RequestJournal, SamplingParams, serving_mesh,
+    )
+    from paddle_tpu.serving.sharding import degrade_step
+
+    j = RequestJournal(sys.argv[-1])
+    pend = j.pending()
+
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    # the serve child ran mp=2 on devices[:2]; the kill lost device 1
+    survivors = [jax.devices()[0]]
+    new_mp = degrade_step(4, 4, len(survivors))
+    t0 = time.perf_counter()
+    eng = Engine(m, mesh=serving_mesh(new_mp, devices=survivors),
+                 num_slots=2, max_seq=32, min_bucket=8)
+    eng.warmup()
+    rebuild_s = time.perf_counter() - t0
+
+    # uninterrupted oracle at the DEGRADED shape, rebuilt from the
+    # journaled replay recipes (seed_effective included) — runs
+    # unjournaled so the exactly-once audit spans only real traffic
+    refs = []
+    for jid, ad in pend.items():
+        s = dict(ad["sampling"])
+        if s.get("seed") is None:
+            s["seed"] = ad["seed_effective"]
+        refs.append(eng.add_request(ad["prompt_ids"],
+                                    max_new_tokens=ad["max_new_tokens"],
+                                    sampling=SamplingParams(**s)))
+    eng.run()
+
+    misses0 = eng.metrics.compile_misses
+    t1 = time.perf_counter()
+    info = eng.recover(j)
+    eng.run()
+    rebuild_s += time.perf_counter() - t1
+    rec = info["requests"]
+    a = j.audit()
+    print(json.dumps({
+        "pending": len(pend),
+        "replayed": info["replayed"],
+        "cross_mesh": info["cross_mesh"],
+        "lost": len(pend) - sum(1 for r in rec
+                                if r.state == "finished"),
+        "match": 1.0 if [r.output_ids for r in rec]
+        == [r.output_ids for r in refs] else 0.0,
+        "steady_misses": eng.metrics.compile_misses - misses0,
+        "rebuild_ms": round(rebuild_s * 1e3, 3),
+        "model_parallel": new_mp,
+        "mesh_shape": eng.mesh_shape,
+        "duplicate_terminals": a["duplicate_terminals"],
+        "mesh_reshards": a["mesh_reshards"],
+        "engine_state": eng.stats()["health"]["state"],
+    }))
+
+
+def _degraded_serving_drill():
+    """Kill-a-shard drill (ISSUE 19): SIGKILL a model=2 serving process
+    mid-decode with streaming requests in flight, then rebuild the
+    group at the largest viable mp' on the surviving device and replay
+    the journal cross-mesh.  Fails structured unless the child died BY
+    SIGKILL, every journaled request came back terminal exactly once
+    (``lost == 0``), the replayed greedy/seeded outputs are bitwise
+    identical to an uninterrupted oracle at the degraded shape, and the
+    rebuilt group ran at zero steady-state recompiles."""
+    import signal
+    import tempfile
+
+    FAIL_METRIC = "serving_gpt_tiny_decode_tokens_per_sec"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    xla = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        env["XLA_FLAGS"] = \
+            (xla + " --xla_force_host_platform_device_count=8").strip()
+    env.pop("PADDLE_TPU_BENCH_SMOKE", None)
+    jdir = tempfile.mkdtemp(prefix="degraded_drill_")
+    serve = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--degraded-serving-serve-child", jdir],
+        capture_output=True, text=True, env=env, timeout=600)
+    if serve.returncode != -signal.SIGKILL:
+        fail_structured(
+            f"kill-a-shard drill: serve child did not die by SIGKILL "
+            f"(rc={serve.returncode}): "
+            + (serve.stderr or serve.stdout)[-800:],
+            metric=FAIL_METRIC)
+    if "KILLING" not in serve.stdout:
+        fail_structured("kill-a-shard drill: child exited before the "
+                        "scripted SIGKILL", metric=FAIL_METRIC)
+    recover = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--degraded-serving-recover-child", jdir],
+        capture_output=True, text=True, env=env, timeout=600)
+    if recover.returncode != 0:
+        fail_structured("kill-a-shard drill: recovery child crashed: "
+                        + (recover.stderr or recover.stdout)[-800:],
+                        metric=FAIL_METRIC)
+    lines = [ln for ln in recover.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    if not lines:
+        fail_structured(f"kill-a-shard drill emitted no JSON: "
+                        f"{recover.stdout[-400:]!r}",
+                        metric=FAIL_METRIC)
+    d = json.loads(lines[-1])
+    if d["lost"] != 0:
+        fail_structured(
+            f"kill-a-shard drill lost {d['lost']} of {d['pending']} "
+            f"journaled requests across the degradation",
+            metric=FAIL_METRIC)
+    if d["match"] != 1.0:
+        fail_structured(
+            "kill-a-shard drill: cross-mesh replay diverges from the "
+            "uninterrupted oracle at the degraded shape",
+            metric=FAIL_METRIC)
+    if d["steady_misses"]:
+        fail_structured(
+            f"kill-a-shard drill: rebuilt group recompiled in steady "
+            f"state: {d['steady_misses']} misses", metric=FAIL_METRIC)
+    if d["duplicate_terminals"]:
+        fail_structured(
+            f"kill-a-shard drill: {d['duplicate_terminals']} duplicate "
+            f"terminals — the exactly-once audit does not span the "
+            f"degradation", metric=FAIL_METRIC)
+    if d["mesh_reshards"] < 1:
+        fail_structured(
+            "kill-a-shard drill: no mesh_reshard record journaled for "
+            "the cross-mesh replay", metric=FAIL_METRIC)
+    return {
+        "serving_degraded_rebuild_ms": d["rebuild_ms"],
+        "serving_degraded_mp": d["model_parallel"],
+        "serving_degraded_replayed": d["replayed"],
+        "serving_degraded_lost": d["lost"],
+    }
+
+
 def serving_main():
     """Serving smoke bench: continuous-batching decode throughput + TTFT
     on the tiny GPT config (ISSUE 3).  Same one-JSON-line contract as the
@@ -867,6 +1063,9 @@ def serving_main():
     # -- tensor-parallel sharded serving: 2-shard vs single-chip ---------
     sharded = _sharded_serving_drill()
 
+    # -- degraded-mode serving: SIGKILL a shard, rebuild smaller ---------
+    degraded = _degraded_serving_drill()
+
     def _p50_ttft_ms(reqs):
         ts = sorted(r.ttft_s for r in reqs)
         return round(ts[len(ts) // 2] * 1e3, 3)
@@ -951,6 +1150,12 @@ def serving_main():
         # throughput ratio prices the per-layer TP all-reduces on the
         # emulated mesh (expect < 1 off-hardware)
         **sharded,
+        # degraded-mode serving (ISSUE 19): a real SIGKILL takes a
+        # shard host mid-decode; the group rebuilds at the largest
+        # viable mp' on the survivors and replays the journal
+        # cross-mesh — lost == 0, bitwise parity vs the uninterrupted
+        # oracle and zero steady-state recompiles all enforced
+        **degraded,
     }))
 
 
@@ -1467,6 +1672,16 @@ if __name__ == "__main__":
         # child half of the sharded serving drill: model=2 TP engine vs
         # single-chip on the 8-device virtual CPU mesh the parent pinned
         _sharded_serving_drill_child()
+        sys.exit(0)
+    if "--degraded-serving-serve-child" in sys.argv:
+        # kill-a-shard drill, serve half: journaled streaming traffic
+        # on a model=2 mesh, SIGKILLs itself mid-decode
+        _degraded_serving_serve_child()
+        sys.exit(0)
+    if "--degraded-serving-recover-child" in sys.argv:
+        # kill-a-shard drill, recovery half: degraded rebuild on the
+        # survivor + cross-mesh journal replay, one JSON line
+        _degraded_serving_recover_child()
         sys.exit(0)
     if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
         import jax

@@ -123,10 +123,15 @@ DEFAULT_STRAGGLER_STALL = 0.25
 #: Fault points the serving engine checks (engine.py _step_call/_emit;
 #: ``serving.prefix_lookup`` fires inside the paged engine's host-side
 #: prefix-cache lookup — a raising/stalling lookup must degrade to a
-#: cache miss, never fail the request or leak a block).  Any point may
-#: carry a replica scope prefix: ``serving.r<k>.<suffix>``.
+#: cache miss, never fail the request or leak a block;
+#: ``serving.shard_fail`` simulates losing one device of a sharded
+#: engine's mesh — the engine marks itself unhealthy with the lost
+#: device recorded, and the fleet rebuilds the group DEGRADED at a
+#: smaller viable mp on the survivors).  Any point may carry a replica
+#: scope prefix: ``serving.r<k>.<suffix>``.
 SERVING_FAULT_POINTS = ("serving.prefill", "serving.decode",
-                        "serving.stream_cb", "serving.prefix_lookup")
+                        "serving.stream_cb", "serving.prefix_lookup",
+                        "serving.shard_fail")
 
 #: ``serving.r<k>.<suffix>`` — a fault point scoped to fleet replica k.
 _SCOPED_POINT_RE = re.compile(r"^serving\.r(\d+)\.(?P<suffix>.+)$")

@@ -71,7 +71,7 @@ import itertools
 import os
 import time
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
@@ -565,6 +565,11 @@ class Engine:
         self.model_version = int(model_version)
         self.state = "active"    # active | draining | stopped | unhealthy
         self._unhealthy_reason: Optional[str] = None
+        #: devices this engine lost (simulated via the
+        #: ``serving.shard_fail`` fault point, or recorded by host-side
+        #: device-loss detection): read by the fleet's degraded rebuild
+        #: to carve the surviving devices into a smaller viable mesh
+        self.lost_devices: List = []
         self._consecutive_failures = 0
         self._step_counter = 0
         self._last_step_t: Optional[float] = None
@@ -752,6 +757,22 @@ class Engine:
         self.state = "unhealthy"
         # post-mortem: freeze the last-N-steps ring while it still shows
         # the lead-up (safe from this thread — the scheduler is stalled)
+        self.flight.dump(self._unhealthy_reason)
+        self.tracer.on_unhealthy(self.name, self._unhealthy_reason)
+
+    def _mark_shard_lost(self, reason) -> None:
+        """Device loss on a sharded engine (the ``serving.shard_fail``
+        fault point): deterministically "lose" the highest-index device
+        of this engine's mesh, record it in ``lost_devices`` for the
+        fleet's degraded rebuild, and go sticky-unhealthy exactly like a
+        watchdog wedge — ejection, flight dump, and supervision all
+        reuse the existing unhealthy machinery."""
+        lost = list(self.shard.mesh.devices.flat)[-1]
+        self.lost_devices = [lost]
+        self._unhealthy_reason = (
+            f"shard failure: lost device {lost} of mesh "
+            f"{self.mesh_shape!r} ({reason})")
+        self.state = "unhealthy"
         self.flight.dump(self._unhealthy_reason)
         self.tracer.on_unhealthy(self.name, self._unhealthy_reason)
 
@@ -1868,6 +1889,21 @@ class Engine:
             raise EngineStopped(
                 f"engine {self.name!r} is unhealthy: "
                 f"{self._unhealthy_reason}")
+        if self.shard is not None and self.fault_plan is not None \
+                and self.fault_plan.armed:
+            # simulated device loss (serving.shard_fail@N): the engine
+            # loses one device of its mesh and goes sticky-unhealthy —
+            # the fleet's supervision ejects it and rebuilds the group
+            # DEGRADED at a smaller viable mp on the survivors
+            from ..distributed.fault_tolerance.injection import \
+                InjectedFault
+            try:
+                self.fault_plan.check("serving.shard_fail")
+            except InjectedFault as e:
+                self._mark_shard_lost(e)
+                raise EngineStopped(
+                    f"engine {self.name!r} is unhealthy: "
+                    f"{self._unhealthy_reason}") from e
         if self._prefill_fn is None:
             self._build_steps()
         self._reap(time.perf_counter())
@@ -2054,7 +2090,7 @@ class Engine:
 
     # -- durability: crash recovery & weight hot-swap ----------------------
 
-    def recover(self, journal=None) -> dict:
+    def recover(self, journal=None, *, cross_mesh: bool = True) -> dict:
         """Crash-consistent recovery: rehydrate every non-terminal
         journaled request (admission recorded, no final end) and
         re-enqueue it as a replay-from-prompt under the stream-restart
@@ -2065,10 +2101,21 @@ class Engine:
         outcomes are banked into the metrics so the counters stay
         monotone across the restart.
 
+        **Cross-mesh replay** (``cross_mesh=True``, the default): a
+        request journaled at a DIFFERENT mesh shape replays here anyway
+        — sharded decoding is bitwise identical across viable ``mp``
+        (the tier-1 parity suite proves it), so a degraded rebuild at a
+        smaller mesh serves the same tokens the original shape
+        promised.  Each shape change is journaled as a ``mesh_reshard``
+        record (old → new shape, per-request disposition) so
+        ``audit()`` spans the degradation exactly-once.
+        ``cross_mesh=False`` restores the strict contract: a
+        shape-mismatched admission fails finally instead of replaying.
+
         Call on a fresh engine AFTER ``warmup()`` and before any
         traffic.  ``journal`` defaults to the engine's own; passing one
-        here also attaches it.  Returns
-        ``{"replayed", "requests", "outcomes"}``."""
+        here also attaches it.  Returns ``{"replayed", "requests",
+        "invalid", "cross_mesh", "outcomes"}``."""
         journal = journal if journal is not None else self.journal
         if journal is None:
             raise ValueError("recover() needs a RequestJournal (pass "
@@ -2087,17 +2134,21 @@ class Engine:
         outcomes = journal.outcomes()
         self.metrics.bank_outcomes(outcomes)
         replayed, invalid = [], []
+        # cross-shape dispositions, grouped by the journaled old shape:
+        # one mesh_reshard record per shape spans the degradation
+        cross: "OrderedDict[Optional[str], OrderedDict[str, str]]" = \
+            OrderedDict()
         saved_max_queue, self.max_queue = self.max_queue, None
         try:
             for jid, rec in journal.pending().items():
-                # bitwise replay assumes the SAME mesh shape: a request
-                # admitted sharded carries its mesh-shape key, and a
-                # recovering engine of a different shape must fail that
-                # replay finally rather than serve it on a topology the
-                # journal never promised (device identities are not part
-                # of the key — any mesh of the same shape replays)
                 want = rec.get("mesh_shape")
-                if want != self.mesh_shape:
+                shape_changed = want != self.mesh_shape
+                if shape_changed and not cross_mesh:
+                    # strict mode: a request admitted sharded carries
+                    # its mesh-shape key, and a recovering engine of a
+                    # different shape fails that replay finally rather
+                    # than serve it on a topology the journal never
+                    # promised
                     journal.record_end(
                         jid, "failed", final=True,
                         error=f"recovery replay rejected: journaled "
@@ -2128,14 +2179,25 @@ class Engine:
                                              f"rejected: {e}",
                                        engine=self.name)
                     invalid.append(getattr(e, "request", None) or jid)
+                    if shape_changed:
+                        cross.setdefault(want, OrderedDict())[jid] = \
+                            "failed"
                     continue
                 finally:
                     journal.end_attempt()
                 replayed.append(r)
+                if shape_changed:
+                    cross.setdefault(want, OrderedDict())[jid] = \
+                        "replayed"
         finally:
             self.max_queue = saved_max_queue
+        for old_shape, requests in cross.items():
+            journal.record_mesh_reshard(
+                self.name, old_shape, self.mesh_shape, requests)
         return {"replayed": len(replayed), "requests": replayed,
-                "invalid": invalid, "outcomes": outcomes}
+                "invalid": invalid,
+                "cross_mesh": sum(len(v) for v in cross.values()),
+                "outcomes": outcomes}
 
     def update_weights(self, state_or_path, *,
                        version: Optional[int] = None) -> int:

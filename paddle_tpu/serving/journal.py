@@ -131,6 +131,10 @@ class RequestJournal:
         self.records_read = 0
         self.records_written = 0
         self.compacted_segments = 0
+        #: mesh_reshard records seen (written + rescanned): how many
+        #: times recovery replayed this journal's pending work across a
+        #: mesh-shape change (degraded-mode sharded serving)
+        self.mesh_reshards = 0
         # aggregate counters for requests whose records left the disk
         # (compaction prunes their per-jid state too — the in-memory
         # maps stay bounded by the UN-compacted suffix, not by all-time
@@ -231,6 +235,12 @@ class RequestJournal:
                 self._finals[jid] = self._finals.get(jid, 0) + 1
                 self._final_state[jid] = rec.get("state", "finished")
                 self._jid_final_seg[jid] = seg
+        elif kind == "mesh_reshard":
+            # a shape-change replay: reference every disposed request so
+            # segment containment (and therefore compaction) treats this
+            # record as part of each request's history
+            jids = list(rec.get("requests", {}))
+            self.mesh_reshards += 1
         elif kind == "compacted":
             # CUMULATIVE totals for everything compaction ever pruned:
             # replace-semantics (later records supersede earlier ones),
@@ -381,6 +391,23 @@ class RequestJournal:
             rec["engine"] = engine
         self._append(rec)
 
+    def record_mesh_reshard(self, engine: str,
+                            old_shape: Optional[str],
+                            new_shape: Optional[str],
+                            requests: Dict[str, str]) -> None:
+        """Recovery replayed journaled work across a mesh-shape change
+        (``old_shape`` → ``new_shape``, e.g. ``"model=2"`` →
+        ``"model=1"`` after a degraded rebuild).  ``requests`` maps each
+        affected journal id to its disposition (``"replayed"`` /
+        ``"redispatched"`` / ``"failed"``) so ``audit()`` spans the
+        degradation: every request is accounted for exactly once, on
+        one side of the shape change or the other."""
+        self._append({"kind": "mesh_reshard", "engine": engine,
+                      "old_shape": old_shape, "new_shape": new_shape,
+                      "requests": {str(j): str(d)
+                                   for j, d in requests.items()},
+                      "wall": round(time.time(), 6)})
+
     def record_weight_swap(self, engine: str, version: int) -> None:
         """A rolling hot-swap bumped this engine to ``version`` — KV
         prefilled before this record was computed under older weights
@@ -486,6 +513,7 @@ class RequestJournal:
             "records_written": self.records_written,
             "segments": len(self._closed_segments) + 1,
             "compacted_segments": self.compacted_segments,
+            "mesh_reshards": self.mesh_reshards,
         }
 
     # -- compaction ---------------------------------------------------------

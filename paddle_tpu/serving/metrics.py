@@ -401,6 +401,13 @@ class FleetMetrics:
         self.rebuild_failures = 0
         self.last_recovery_s: Optional[float] = None
         self.total_recovery_s = 0.0
+        # degraded-mode sharded serving: shard-group rebuilds at a
+        # smaller viable mp after device loss
+        self.degrades = 0
+        self.last_degrade_old_mp: Optional[int] = None
+        self.last_degrade_mp: Optional[int] = None
+        self.last_degrade_s: Optional[float] = None
+        self.total_degrade_s = 0.0
         # durability (ISSUE 14): crash recovery + rolling weight rolls
         self.banked_outcomes: Dict[str, int] = {}
         self.requests_recovered = 0
@@ -459,6 +466,18 @@ class FleetMetrics:
             self.total_recovery_s += recovery_s
         else:
             self.rebuild_failures += 1
+
+    def on_degrade(self, old_mp: int, new_mp: int,
+                   recovery_s: float) -> None:
+        """A shard group was rebuilt DEGRADED — at ``new_mp < old_mp``
+        on its surviving devices after device loss.  ``recovery_s`` is
+        the same eject→rejoin wall time ``on_rebuild`` records (every
+        degrade is also counted as a rebuild)."""
+        self.degrades += 1
+        self.last_degrade_old_mp = int(old_mp)
+        self.last_degrade_mp = int(new_mp)
+        self.last_degrade_s = recovery_s
+        self.total_degrade_s += recovery_s
 
     def bank_outcomes(self, outcomes: Dict[str, int]) -> None:
         """Fold a recovered journal's pre-crash FINAL terminal counts
@@ -525,6 +544,14 @@ class FleetMetrics:
                 "last_recovery_ms": None if self.last_recovery_s is None
                 else round(self.last_recovery_s * 1e3, 3),
                 "total_recovery_ms": round(self.total_recovery_s * 1e3, 3),
+            },
+            "degraded": {
+                "degrades": self.degrades,
+                "last_old_mp": self.last_degrade_old_mp,
+                "last_mp": self.last_degrade_mp,
+                "last_degrade_ms": None if self.last_degrade_s is None
+                else round(self.last_degrade_s * 1e3, 3),
+                "total_degrade_ms": round(self.total_degrade_s * 1e3, 3),
             },
             "durability": {
                 "crash_recoveries": self.crash_recoveries,

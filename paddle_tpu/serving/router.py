@@ -150,7 +150,7 @@ class _Replica:
 
     __slots__ = ("index", "engine", "state", "ejections", "rebuilds",
                  "rebuild_attempts", "last_error", "_eject_t",
-                 "flight_dumps")
+                 "flight_dumps", "degraded")
 
     def __init__(self, index: int, engine: Engine):
         self.index = index
@@ -165,9 +165,16 @@ class _Replica:
         #: record's post-mortem attachment (the ejected engine itself is
         #: discarded, so the fleet keeps the dump alive)
         self.flight_dumps: List[dict] = []
+        #: True once a degraded rebuild shrank this group's mesh below
+        #: the fleet's configured ``shards_per_group``
+        self.degraded = False
 
     def load(self) -> int:
         return len(self.engine.queue) + len(self.engine.running)
+
+    def model_parallel(self) -> int:
+        shard = getattr(self.engine, "shard", None)
+        return shard.mp if shard is not None else 1
 
 
 class Fleet:
@@ -264,11 +271,28 @@ class Fleet:
         # rolling update_weights, ejection/rebuild, journal recovery)
         # applies to shard groups without a line of new control flow.
         self.shards_per_group = int(shards_per_group)
+        self.model = Engine.resolve_model(model_or_config)
         if self.shards_per_group > 1:
             import jax
 
-            from .sharding import serving_mesh
+            from .sharding import serving_mesh, viable_ladder
 
+            # viability at construction (degraded-mode contract): the
+            # configured mp must sit ON the model's viability ladder —
+            # the same divisibility rules ServingShard enforces, named
+            # here so a misconfigured fleet fails with the full ladder
+            # (and therefore the degrade steps available to it) instead
+            # of a bare divisibility error per engine
+            kv, nh = self._head_counts()
+            ladder = viable_ladder(kv, nh)
+            if self.shards_per_group not in ladder:
+                raise ValueError(
+                    f"shards_per_group={self.shards_per_group} is not a "
+                    f"viable model-parallel degree for this model "
+                    f"(kv_heads={kv}, num_attention_heads={nh}): the "
+                    f"viable ladder is {ladder} — every mp must divide "
+                    f"both head counts so the KV pool shards whole GQA "
+                    f"groups")
             devs = jax.devices()
             need = num_replicas * self.shards_per_group
             if len(devs) < need:
@@ -276,14 +300,21 @@ class Fleet:
                     f"shards_per_group={self.shards_per_group} with "
                     f"num_replicas={num_replicas} needs {need} devices "
                     f"(disjoint per-group meshes), have {len(devs)}")
-            self._group_meshes: List[Optional[object]] = [
-                serving_mesh(self.shards_per_group,
-                             devices=devs[k * self.shards_per_group:
-                                          (k + 1) * self.shards_per_group])
+            #: each group's ORIGINAL device slice — the degraded rebuild
+            #: carves its smaller mesh out of whichever of these survive
+            self._group_devices: List[Optional[list]] = [
+                list(devs[k * self.shards_per_group:
+                          (k + 1) * self.shards_per_group])
                 for k in range(num_replicas)]
+            self._group_meshes: List[Optional[object]] = [
+                serving_mesh(self.shards_per_group, devices=slice_)
+                for slice_ in self._group_devices]
         else:
+            self._group_devices = [None] * num_replicas
             self._group_meshes = [None] * num_replicas
-        self.model = Engine.resolve_model(model_or_config)
+        #: devices recorded lost at ejection (``engine.lost_devices``):
+        #: never handed to a rebuilt mesh again
+        self._failed_devices: set = set()
         #: current fleet-wide weight version (bumped by update_weights;
         #: replicas join rolls — and rebuilds — at this version)
         self.model_version = 0
@@ -352,6 +383,14 @@ class Fleet:
 
     # -- replica construction ----------------------------------------------
 
+    def _head_counts(self) -> Tuple[int, int]:
+        """(kv_heads, num_attention_heads) of the served model — the
+        two divisors the viability ladder is built from (the same
+        resolution Engine uses for its ServingShard)."""
+        cfg = self.model.config
+        kv = getattr(cfg, "n_kv_heads", None) or cfg.num_attention_heads
+        return int(kv), int(cfg.num_attention_heads)
+
     def _replica_model(self):
         """The model a new replica engine serves: a per-replica clone
         of the template (current weights copied in) under weight
@@ -417,11 +456,22 @@ class Fleet:
         best_hit = max(hit for _, hit in probed)
         if best_hit > 0:
             tied = [rep for rep, hit in probed if hit == best_hit]
-            return min(tied, key=lambda r: r.load()), best_hit
+            return min(tied, key=self._effective_load), best_hit
         self._rr += 1
         order = cands[self._rr % len(cands):] + \
             cands[:self._rr % len(cands)]
-        return min(order, key=lambda r: r.load()), 0
+        return min(order, key=self._effective_load), 0
+
+    def _effective_load(self, rep: _Replica) -> float:
+        """Dispatch-capacity rebalance: a DEGRADED group (rebuilt at a
+        smaller mp after device loss) runs the same slot count on fewer
+        chips, so its load is weighted up by ``full_mp / current_mp`` —
+        least-loaded dispatch then naturally routes proportionally less
+        new traffic to it, without starving it entirely."""
+        mp = rep.model_parallel()
+        if mp >= self.shards_per_group:
+            return float(rep.load())
+        return rep.load() * (self.shards_per_group / max(mp, 1))
 
     def _wrap_stream(self, freq: FleetRequest):
         """Per-attempt stream adapter: mirrors tokens onto the fleet
@@ -797,6 +847,7 @@ class Fleet:
         orphaned requests collected for replay), rebuild every ejected
         replica, then re-dispatch the orphans onto the healed fleet."""
         orphans: List[Tuple[FleetRequest, str]] = []
+        orphan_jids: Dict[int, List[str]] = {}
         for rep in self.replicas:
             if rep.state not in ("active", "updating"):
                 continue
@@ -809,10 +860,15 @@ class Fleet:
                           "compiled-step failures")
             else:
                 continue
-            orphans.extend(self._eject(rep, reason))
+            mine = self._eject(rep, reason)
+            orphans.extend(mine)
+            orphan_jids[rep.index] = [
+                freq.journal_id for freq, _ in mine
+                if freq.journal_id is not None]
         for rep in self.replicas:
             if rep.state == "ejected":
-                self._rebuild(rep)
+                self._rebuild(rep,
+                              orphan_jids=orphan_jids.get(rep.index, []))
         for freq, err in orphans:
             self._redispatch_or_fail(freq, err)
 
@@ -825,6 +881,11 @@ class Fleet:
         rep.ejections += 1
         rep._eject_t = time.perf_counter()
         rep.last_error = reason
+        # devices the engine recorded lost (serving.shard_fail or real
+        # device-loss detection) leave the pool for good: the rebuild
+        # carves its mesh from whatever survives
+        self._failed_devices.update(
+            getattr(rep.engine, "lost_devices", ()))
         # the engine leaves rotation: bank its preemption counter so
         # the fleet aggregate survives the rebuild's fresh engine, and
         # freeze its flight recorder — the last-N-steps post-mortem is
@@ -858,11 +919,51 @@ class Fleet:
     #: supervision pass, one per fleet step).
     MAX_REBUILD_ATTEMPTS = 3
 
-    def _rebuild(self, rep: _Replica) -> None:
+    def _rebuild(self, rep: _Replica,
+                 orphan_jids: Sequence[str] = ()) -> None:
         """Heal an ejected replica: fresh engine (fresh pool, fresh
         prefix cache, fresh executables), re-warm, rejoin rotation.  The
         eject→rejoin wall time is the fleet's measured failover
-        recovery."""
+        recovery.
+
+        **Degraded rebuild** (sharded groups): when ejection recorded
+        lost devices, the group's surviving slice may no longer fit its
+        configured mp — the rebuild then walks DOWN the viability
+        ladder to the largest ``mp'`` the survivors support (down to
+        ``mp'=1``) and carves a smaller mesh instead of dying.  The
+        shape change is journaled as a ``mesh_reshard`` record carrying
+        each orphaned request's disposition (``"redispatched"`` — they
+        replay through the normal post-supervision pass), the degrade
+        is counted/traced, and dispatch capacity rebalances via
+        ``_effective_load``.  Only when not even ``mp'=1`` fits (every
+        device of the slice lost) does the group go dead."""
+        degrade = None                   # (old_mp, new_mp, old_key)
+        devs = self._group_devices[rep.index]
+        if devs is not None:
+            from .sharding import (
+                degrade_step, mesh_shape_key, serving_mesh, viable_ladder,
+            )
+
+            survivors = [d for d in devs
+                         if d not in self._failed_devices]
+            old_mesh = self._group_meshes[rep.index]
+            old_mp = rep.model_parallel()
+            if len(survivors) < old_mp:
+                kv, nh = self._head_counts()
+                new_mp = degrade_step(kv, nh, len(survivors))
+                if new_mp is None:
+                    rep.state = "dead"
+                    rep.last_error = (
+                        f"no viable degraded mesh: {len(survivors)} "
+                        f"surviving device(s) in the group, viable "
+                        f"ladder {viable_ladder(kv, nh)}")
+                    self.metrics.on_rebuild(0.0, ok=False)
+                    self.tracer.on_rebuild(rep.engine.name, 0.0,
+                                           ok=False)
+                    return
+                self._group_meshes[rep.index] = serving_mesh(
+                    new_mp, devices=survivors)
+                degrade = (old_mp, new_mp, mesh_shape_key(old_mesh))
         try:
             eng = self._make_engine(rep.index)
             eng.warmup()
@@ -886,6 +987,15 @@ class Fleet:
         rep._eject_t = None
         self.metrics.on_rebuild(recovery)
         self.tracer.on_rebuild(eng.name, recovery)
+        if degrade is not None:
+            old_mp, new_mp, old_key = degrade
+            rep.degraded = new_mp < self.shards_per_group
+            self.metrics.on_degrade(old_mp, new_mp, recovery)
+            self.tracer.on_degrade(eng.name, old_mp, new_mp, recovery)
+            if self.journal is not None:
+                self.journal.record_mesh_reshard(
+                    eng.name, old_key, eng.mesh_shape,
+                    {jid: "redispatched" for jid in orphan_jids})
 
     # -- durability: crash recovery & rolling weight hot-swap --------------
 
@@ -1168,6 +1278,8 @@ class Fleet:
                 "occupancy": round(m.occupancy(), 4),
                 "compile_misses": m.compile_misses,
                 "mesh_shape": eng.mesh_shape,
+                "model_parallel": rep.model_parallel(),
+                "degraded": rep.degraded,
                 "preemptions": m.requests_preempted,
                 "shed": m.requests_shed,
                 # the rebuild record's post-mortem attachment: a summary
@@ -1230,6 +1342,18 @@ class Fleet:
         if self.journal is not None:
             out["durability"]["journal"] = self.journal.stats()
         out["overload"] = self._overload_section()
+        # degraded-mode view (docs/SERVING.md "Degraded sharded
+        # serving"): the FleetMetrics "degraded" counters plus the live
+        # per-group mp and the devices the fleet has written off
+        out.setdefault("degraded", {})
+        out["degraded"]["failed_devices"] = len(self._failed_devices)
+        out["degraded"]["groups"] = {
+            rep.engine.name: {
+                "model_parallel": rep.model_parallel(),
+                "configured": self.shards_per_group,
+                "degraded": rep.degraded,
+                "state": rep.state,
+            } for rep in self.replicas}
         if self.tracer.enabled:
             out["tracing"] = self.tracer.snapshot()
         out["engines"] = {rep.engine.name: rep.engine.stats()

@@ -67,7 +67,7 @@ from ..distributed.sharding_spec import (
 )
 
 __all__ = ["ServingShard", "serving_mesh", "mesh_shape_key",
-           "KV_POOL_SPEC"]
+           "viable_ladder", "degrade_step", "KV_POOL_SPEC"]
 
 #: KV pools are 5-D with kv_heads at dim 3 in BOTH layouts:
 #: contiguous ``[slots, layers, max_seq, kv_heads, head_dim]`` and
@@ -101,6 +101,36 @@ def serving_mesh(model_parallel: int,
             f"have {len(devices)} (on CPU set XLA_FLAGS="
             f"--xla_force_host_platform_device_count before jax import)")
     return mesh_mod.build_mesh({MODEL_AXIS: mp}, devices[:mp])
+
+
+def viable_ladder(kv_heads: int, num_heads: int,
+                  max_mp: Optional[int] = None) -> list:
+    """The ascending list of viable model-parallel degrees for a model:
+    every ``mp`` with ``mp | kv_heads`` AND ``mp | num_heads`` (the same
+    two divisibility rules :class:`ServingShard` enforces), optionally
+    capped at ``max_mp``.  ``1`` is always viable — the degraded-mode
+    floor is the unsharded engine.
+
+    This is the **viability ladder** degraded serving walks down: when a
+    shard group loses devices, the fleet rebuilds it at the LARGEST
+    rung that still fits on the survivors (:func:`degrade_step`)."""
+    kv, nh = int(kv_heads), int(num_heads)
+    if kv < 1 or nh < 1:
+        raise ValueError(f"viable_ladder: kv_heads={kv_heads} and "
+                         f"num_heads={num_heads} must be >= 1")
+    top = min(kv, nh) if max_mp is None else int(max_mp)
+    return [mp for mp in range(1, top + 1)
+            if kv % mp == 0 and nh % mp == 0]
+
+
+def degrade_step(kv_heads: int, num_heads: int,
+                 survivors: int) -> Optional[int]:
+    """The largest viable ``mp'`` that fits on ``survivors`` devices —
+    the degraded-rebuild target after a shard group loses devices.
+    ``None`` when not even ``mp'=1`` fits (zero survivors): the group
+    is dead until hardware returns."""
+    ladder = viable_ladder(kv_heads, num_heads, max_mp=survivors)
+    return ladder[-1] if ladder else None
 
 
 def mesh_shape_key(mesh: Optional[Mesh]) -> Optional[str]:

@@ -421,6 +421,15 @@ class RequestTracer:
         self._event("rebuild", replica=replica, ok=ok,
                     recovery_ms=round(recovery_s * 1e3, 3))
 
+    def on_degrade(self, replica: str, old_mp: int, new_mp: int,
+                   recovery_s: float) -> None:
+        """A shard group was rebuilt DEGRADED at a smaller viable mp
+        on its surviving devices (always paired with an on_rebuild
+        event carrying the same recovery time)."""
+        self._event("degrade", replica=replica, old_mp=int(old_mp),
+                    new_mp=int(new_mp),
+                    recovery_ms=round(recovery_s * 1e3, 3))
+
     # -- introspection ------------------------------------------------------
 
     def traces(self) -> List[str]:

@@ -26,13 +26,27 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", _plat)
 jax.config.update("jax_default_matmul_precision", "highest")
 
-# Persistent compilation cache: the distributed/pipeline tests are
-# compile-bound; caching across pytest runs cuts the suite from ~10 min of
-# XLA compiles to seconds on re-runs.
-_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), ".xla_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Persistent compilation cache: OPT-IN ONLY (PADDLE_TPU_XLA_CACHE_DIR).
+#
+# It used to be on by default (`.xla_cache/`, cutting warm re-runs by
+# ~10 min of XLA compiles), but executables DESERIALIZED from the
+# persistent cache are not bitwise-equivalent to freshly compiled ones
+# on this toolchain: with a warm cache the test_sentry rollback-parity
+# suite failed 6/8 runs (digest mismatches that flipped run-to-run,
+# plus one `free(): invalid pointer` abort in the deserialization
+# path), and 8/8 passed cold.  Because cache warmth depends on what
+# compiled earlier, the failures masqueraded for two PRs as
+# "order-sensitive" cross-file state leaks.  Every bitwise invariant
+# this suite pins (rollback parity, sharded-vs-single-chip serving,
+# resharded resume, spec-decode acceptance) is hostage to that
+# nondeterminism, so correctness wins: no persistent cache unless a
+# developer explicitly asks for one — and the parity suites are
+# expected to flake when they do.  tests/test_isolation.py pins the
+# default-off contract.
+_cache_dir = os.environ.get("PADDLE_TPU_XLA_CACHE_DIR")
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def pytest_configure(config):

@@ -168,6 +168,17 @@ def test_serving_mode_emits_json_line():
     assert out["serving_sharded_tokens_per_sec"] > 0
     assert out["serving_sharded_mesh_shape"] == "model=2"
     assert out["serving_sharded_vs_single_chip"] > 0
+    # degraded-mode serving (ISSUE 19): the kill-a-shard drill SIGKILLed
+    # a model=2 serving process mid-decode, rebuilt the group at the
+    # largest viable mp' on the survivor, and replayed the journal
+    # cross-mesh (bench fails structured on any lost request, output
+    # divergence from the uninterrupted oracle, steady-state recompile,
+    # or duplicate terminal) — mp' is 1 on the 1-survivor drill and
+    # nothing may be lost, ever
+    assert out["serving_degraded_rebuild_ms"] > 0
+    assert out["serving_degraded_mp"] == 1
+    assert out["serving_degraded_replayed"] >= 1
+    assert out["serving_degraded_lost"] == 0
 
 
 def test_preflight_failure_is_structured():

@@ -229,12 +229,15 @@ class TestShardedRecovery:
         assert all(got[tuple(p)] == o for p, o in zip(PROMPTS, want))
         assert e2.metrics.compile_misses == warm
 
-    def test_recovery_rejects_mesh_shape_mismatch(self, models,
-                                                  tmp_path):
-        """Pending work journaled on a model=2 mesh must NOT silently
-        replay on an engine of a different shape — a half-width replay
-        would not be the bitwise rerun durability promises.  The
-        mismatch is a per-request terminal failure, not a crash."""
+    def test_strict_recovery_rejects_mesh_shape_mismatch(self, models,
+                                                         tmp_path):
+        """``recover(cross_mesh=False)`` keeps the strict shape
+        contract: pending work journaled on a model=2 mesh fails
+        finally on an engine of a different shape instead of replaying.
+        (The DEFAULT since degraded-mode serving is cross-mesh replay —
+        tests/test_degraded_serving.py proves it bitwise both
+        directions; strict mode remains for operators who want a shape
+        mismatch to be loud.)"""
         j = RequestJournal(str(tmp_path))
         e1 = Engine(_clone(models["gpt"]), journal=j,
                     mesh=serving_mesh(2), **ENGINE_KW)
@@ -245,10 +248,14 @@ class TestShardedRecovery:
         j2 = RequestJournal(str(tmp_path))
         assert len(j2.pending()) == 1
         e2 = Engine(_clone(models["gpt"]), journal=j2, **ENGINE_KW)
-        info = e2.recover()              # unsharded: shape None != model=2
+        info = e2.recover(cross_mesh=False)   # shape None != model=2
         assert info["replayed"] == 0 and len(info["invalid"]) == 1
+        assert info["cross_mesh"] == 0
         # the rejection is durable: a third scan sees no pending work
-        assert not RequestJournal(str(tmp_path)).pending()
+        j3 = RequestJournal(str(tmp_path))
+        assert not j3.pending()
+        # strict refusal writes NO mesh_reshard record
+        assert j3.mesh_reshards == 0
 
 
 # ---------------------------------------------------------------------------

@@ -67,11 +67,14 @@ CANONICAL = {
     # (shape/dtype/stop_gradient only), so each section must be the
     # SAME closed set — build_manifest enumerates under each mesh and
     # raises if a single key differs from the unsharded enumeration.
-    # model=1 is deliberately NOT enumerated: a size-1 axis filters out
-    # of every placement spec, so it is bitwise the unsharded engine
-    # (tests/test_sharded_serving.py proves that end to end) and
-    # enumerating it would double this pass to prove a tautology.
-    "serving_mesh_shapes": [2],
+    # model=1 joined the enumeration with degraded-mode serving
+    # (ISSUE 19): it is no longer only the degenerate tautology a
+    # size-1 axis filters out of every placement spec — it is the
+    # floor of the viability ladder a failed shard group REBUILDS at
+    # (tests/test_degraded_serving.py, the bench kill-a-shard drill),
+    # so the manifest must prove the degraded shape's key space is the
+    # same closed set tier-1 warms.
+    "serving_mesh_shapes": [2, 1],
 }
 
 
