@@ -93,6 +93,10 @@ class ColumnParallelLinear(Layer):
             set_param_spec(self.bias, P(MODEL_AXIS))
         else:
             self.bias = None
+        #: serving.adapters multi-LoRA hook (``out = lora(x, out)``);
+        #: None — the default, and the identity everywhere outside an
+        #: engine step — keeps this layer's trace byte-identical
+        self.lora = None
 
     def forward(self, x):
         chunks = tp_overlap.effective_chunks(self._tp_overlap_chunks)
@@ -100,10 +104,11 @@ class ColumnParallelLinear(Layer):
             out = tp_overlap.column_parallel_linear(
                 x, self.weight, self.bias, chunks, self.gather_output)
             if out is not None:
-                return out
+                return out if self.lora is None else self.lora(x, out)
         out = F.linear(x, self.weight, self.bias)
         last = None if self.gather_output else MODEL_AXIS
-        return mark_sharding(out, batch_spec(out.ndim, last=last))
+        out = mark_sharding(out, batch_spec(out.ndim, last=last))
+        return out if self.lora is None else self.lora(x, out)
 
 
 class RowParallelLinear(Layer):
@@ -131,6 +136,8 @@ class RowParallelLinear(Layer):
             set_param_spec(self.bias, P())
         else:
             self.bias = None
+        #: serving.adapters multi-LoRA hook — see ColumnParallelLinear
+        self.lora = None
 
     def forward(self, x):
         chunks = tp_overlap.effective_chunks(self._tp_overlap_chunks)
@@ -140,11 +147,12 @@ class RowParallelLinear(Layer):
             out = tp_overlap.row_parallel_linear(
                 x, self.weight, self.bias, chunks)
             if out is not None:
-                return out
+                return out if self.lora is None else self.lora(x, out)
         if not self.input_is_parallel:
             x = mark_sharding(x, batch_spec(x.ndim, last=MODEL_AXIS))
         out = F.linear(x, self.weight, self.bias)
-        return mark_sharding(out, batch_spec(out.ndim, last=None))
+        out = mark_sharding(out, batch_spec(out.ndim, last=None))
+        return out if self.lora is None else self.lora(x, out)
 
 
 class ParallelCrossEntropy(Layer):

@@ -59,6 +59,19 @@ one drained replica at a time (in-place buffer write-through: zero new
 compile keys; prefix-cache version epoch: zero stale-weight KV hits) —
 see docs/SERVING.md "Durability & hot swap".
 
+Multi-tenancy shares one compiled engine across tenants: per-request
+LoRA adapter lanes (:class:`AdapterPool` — stacked low-rank banks
+gathered per slot inside the SAME prefill/decode/verify programs, lane
+ids as data so one executable serves every tenant; load/unload/hot-swap
+at runtime with version epochs salting the prefix cache), per-request
+constrained decoding (:class:`GrammarTable` /
+:class:`JsonArrayGrammar` — a precompiled DFA mask table indexed by a
+per-slot state lane advanced in-graph, composing with every sampling
+law and with speculative verify), and per-tenant SLO accounting
+(tenant-labelled TTFT/throughput in :class:`ServingMetrics`, tenant
+tags in the tracer, adapter/grammar journaled per admission for
+bitwise crash replay) — see docs/SERVING.md "Multi-tenant serving".
+
 One level up, the fleet degrades per-replica, never per-fleet:
 :class:`Fleet` supervises N engine replicas behind one
 submit/stream/cancel surface — prefix-affinity dispatch, health-driven
@@ -88,6 +101,10 @@ from .engine import (  # noqa: F401
     PRIORITY_LOW, PRIORITY_NORMAL, PRIORITY_HIGH,
 )
 from .spec_decode import SpecConfig, SpecState  # noqa: F401
+from .adapters import (  # noqa: F401
+    AdapterConfig, AdapterPool, make_lora_weights,
+)
+from .grammar import GrammarTable, JsonArrayGrammar  # noqa: F401
 from .sharding import (  # noqa: F401
     ServingShard, mesh_shape_key, serving_mesh,
 )
@@ -105,4 +122,6 @@ __all__ = ["KVCache", "CacheContext", "Engine", "Request",
            "FlightRecorder", "validate_trace",
            "RequestJournal", "JournalCorrupt",
            "SpecConfig", "SpecState",
+           "AdapterConfig", "AdapterPool", "make_lora_weights",
+           "GrammarTable", "JsonArrayGrammar",
            "ServingShard", "serving_mesh", "mesh_shape_key"]

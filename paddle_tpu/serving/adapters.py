@@ -1,0 +1,287 @@
+"""Multi-LoRA serving: per-request low-rank adapter lanes.
+
+One deployment serves N fine-tunes of one base model (ROADMAP item 4a).
+The design rides the same economics as every other per-request knob in
+the serving stack (docs/SERVING.md "Multi-tenant serving"):
+
+- **Which adapter a slot decodes under is data, never a trace constant.**
+  An :class:`AdapterPool` stacks up to ``max_adapters`` adapters' (A, B)
+  factor pairs into per-target-linear device lanes —
+  ``A [L, in, rank]`` / ``B [L, rank, out]`` with lane 0 the reserved
+  all-zero *base* adapter — plus one ``adapter_ids [slots] int32`` lane.
+  All of it is persistable lifted state (like the KV cache and sampler
+  lanes), so one compiled prefill/decode/verify program serves every
+  tenant and adding the pool changes ZERO executable-cache keys.
+- **The low-rank math lives inside the compiled step.**  Each
+  tensor-parallel linear (the Megatron Column/Row layers every GPT/Llama
+  projection is built from) gathers its slot's factor pair and adds
+  ``scale * (x @ A) @ B`` to its output in-graph.  A pure add would
+  break bitwise base parity for lane 0 (``-0.0 + 0.0 == +0.0``), so the
+  hook selects: ``where(adapter_id > 0, out + delta, out)`` — slots on
+  the base adapter are bitwise untouched.
+- **Host side is a tiny registry.**  ``load``/``unload``/hot-swap write
+  lane rows through ``_set_data`` between steps (value-only, never a
+  shape).  Each adapter *name* carries a monotonically increasing
+  **version** (bumped on every load of that name, surviving unload), and
+  ``salt(name) == b"name@vN"`` feeds the prefix cache's chain-hash root
+  so tenant KV never cross-hits another tenant — or a stale version of
+  itself — by construction.
+
+Sharding (serving.sharding.ServingShard): adapter factors shard over the
+``model`` mesh axis exactly like the weights they modify — a column
+target (out-dim sharded) shards ``B``'s out dim, a row target (in-dim
+sharded) shards ``A``'s in dim; the id lane replicates.
+
+Deliberately NOT supported: per-slot adapter *rank* (lanes are one
+stacked shape; rank is a pool constant), adapters on the draft model
+(speculative acceptance prices the real draft law, so an un-adapted
+draft only costs acceptance rate, never correctness), and adapters on
+embeddings / lm_head (target set = the Column/Row projections).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["AdapterConfig", "AdapterPool", "make_lora_weights"]
+
+
+@dataclass
+class AdapterConfig:
+    """Engine-facing pool sizing: how many concurrently loaded adapters
+    (``max_adapters`` — lane 0 is the base model and does not count) at
+    which low-rank width (``rank``, one pool-wide constant: the stacked
+    lanes have ONE shape)."""
+
+    max_adapters: int = 4
+    rank: int = 4
+
+    def __post_init__(self):
+        if self.max_adapters < 1:
+            raise ValueError("max_adapters must be >= 1")
+        if self.rank < 1:
+            raise ValueError("rank must be >= 1")
+
+
+@dataclass
+class _Bank:
+    """One target linear's stacked factors."""
+
+    key: str                  # model path of the target layer
+    kind: str                 # "column" | "row" (which factor shards)
+    in_features: int
+    out_features: int
+    A: Tensor                 # [L, in, rank]
+    B: Tensor                 # [L, rank, out]
+
+
+class _LoraHook:
+    """Installed as ``layer.lora``; called by the Column/Row forward as
+    ``out = hook(x, out)``.  Outside an engine step (no staged row ids)
+    it is the identity — direct model calls never see adapter math."""
+
+    def __init__(self, pool: "AdapterPool", key: str):
+        self._pool = pool
+        self._key = key
+
+    def __call__(self, x, out):
+        rows = self._pool._rows
+        if rows is None:
+            return out
+        bank = self._pool.banks[self._key]
+        xv, ov = x._value(), out._value()
+        A = bank.A._value()[rows]                      # [b, in, rank]
+        B = bank.B._value()[rows]                      # [b, rank, out]
+        delta = jnp.einsum("bsr,bro->bso",
+                           jnp.einsum("bsi,bir->bsr", xv, A), B)
+        keep = (rows > 0)[:, None, None]
+        return Tensor._wrap(jnp.where(keep, ov + delta, ov))
+
+
+class AdapterPool:
+    """Stacked per-target LoRA lanes + the per-slot adapter-id lane.
+
+    Built against a target model: every ``ColumnParallelLinear`` /
+    ``RowParallelLinear`` sublayer becomes a target and gets a
+    :class:`_LoraHook` installed.  ``num_slots`` sizes the id lane.
+    """
+
+    def __init__(self, model, num_slots: int, *, max_adapters: int = 4,
+                 rank: int = 4, dtype=None):
+        from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers \
+            import ColumnParallelLinear, RowParallelLinear
+
+        self.num_slots = int(num_slots)
+        self.max_adapters = int(max_adapters)
+        self.rank = int(rank)
+        self.num_lanes = self.max_adapters + 1        # lane 0 = base
+        if dtype is None:
+            params = model.parameters()
+            dtype = params[0].dtype if params else "float32"
+        self.dtype = dtype
+        self.banks: Dict[str, _Bank] = {}
+        for path, layer in model.named_sublayers():
+            if isinstance(layer, ColumnParallelLinear):
+                kind = "column"
+            elif isinstance(layer, RowParallelLinear):
+                kind = "row"
+            else:
+                continue
+            A = Tensor._wrap(jnp.zeros(
+                (self.num_lanes, layer._in_features, self.rank),
+                dtype=jnp.dtype(dtype)))
+            B = Tensor._wrap(jnp.zeros(
+                (self.num_lanes, self.rank, layer._out_features),
+                dtype=jnp.dtype(dtype)))
+            A.persistable = True
+            B.persistable = True
+            self.banks[path] = _Bank(path, kind, layer._in_features,
+                                     layer._out_features, A, B)
+            layer.lora = _LoraHook(self, path)
+        if not self.banks:
+            raise ValueError(
+                "AdapterPool found no ColumnParallelLinear/"
+                "RowParallelLinear targets in the model")
+        self.adapter_ids = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        self.adapter_ids.persistable = True
+        #: traced per-call row ids ([1] prefill / [slots] decode+verify);
+        #: set by the engine's step closures around the model call,
+        #: None outside a step (the hooks are then the identity)
+        self._rows = None
+        self._registry: Dict[str, int] = {}           # name -> lane
+        self._versions: Dict[str, int] = {}           # name -> version
+        self._free = list(range(1, self.num_lanes))
+
+    # -- registry ----------------------------------------------------------
+
+    @property
+    def loaded(self) -> Dict[str, int]:
+        """name -> current version, for every loaded adapter."""
+        return {n: self._versions[n] for n in self._registry}
+
+    def resolve(self, name: str) -> Tuple[int, int]:
+        """``(lane, version)`` of a loaded adapter; KeyError if not."""
+        try:
+            lane = self._registry[name]
+        except KeyError:
+            raise KeyError(
+                f"adapter {name!r} is not loaded (loaded: "
+                f"{sorted(self._registry)})") from None
+        return lane, self._versions[name]
+
+    def last_version(self, name: str) -> int:
+        """Latest version this pool ever assigned ``name`` (0 if never
+        loaded) — survives unload, for machine-readable error context."""
+        return self._versions.get(name, 0)
+
+    def salt(self, name: Optional[str]) -> bytes:
+        """Prefix-cache tenant salt: b"" for the base model, else
+        ``b"name@vN"`` — folded into the chain-hash root so tenant KV
+        never cross-hits across adapters OR versions."""
+        if name is None:
+            return b""
+        lane, version = self.resolve(name)
+        return f"{name}@v{version}".encode()
+
+    def load(self, name: str, weights: Dict[str, tuple], *,
+             scale: float = 1.0) -> Tuple[int, int]:
+        """Load (or hot-swap) adapter ``name`` from ``weights``: a dict
+        mapping every target path to its ``(A [in, rank], B [rank, out])``
+        pair.  ``scale`` is folded into B at write time.  Returns
+        ``(lane, version)``; the version bumps on every load of the same
+        name (including load-over-loaded hot swaps), which retires the
+        old version's prefix-cache salt."""
+        missing = sorted(set(self.banks) - set(weights))
+        extra = sorted(set(weights) - set(self.banks))
+        if missing or extra:
+            raise ValueError(
+                f"adapter {name!r} weights do not cover the target set "
+                f"(missing={missing[:3]}, unexpected={extra[:3]})")
+        if name in self._registry:
+            lane = self._registry[name]
+        else:
+            if not self._free:
+                raise RuntimeError(
+                    f"adapter pool is full ({self.max_adapters} lanes; "
+                    f"loaded: {sorted(self._registry)}) — unload one "
+                    "first")
+            lane = self._free.pop(0)
+        for key, bank in self.banks.items():
+            A, B = weights[key]
+            A = jnp.asarray(np.asarray(A), dtype=jnp.dtype(self.dtype))
+            B = jnp.asarray(np.asarray(B),
+                            dtype=jnp.dtype(self.dtype)) * float(scale)
+            if A.shape != bank.A._value().shape[1:] or \
+                    B.shape != bank.B._value().shape[1:]:
+                raise ValueError(
+                    f"adapter {name!r} target {key!r}: want A "
+                    f"{bank.A._value().shape[1:]} / B "
+                    f"{bank.B._value().shape[1:]}, got {A.shape} / "
+                    f"{B.shape}")
+            bank.A._set_data(bank.A._value().at[lane].set(A))
+            bank.B._set_data(bank.B._value().at[lane].set(B))
+        self._registry[name] = lane
+        self._versions[name] = self._versions.get(name, 0) + 1
+        return lane, self._versions[name]
+
+    def unload(self, name: str) -> int:
+        """Unload ``name``: zero its lane (so a stale id could only ever
+        reproduce the base model, never another tenant) and free it.
+        Returns the unloaded version; the name's version counter
+        survives for a later reload."""
+        lane, version = self.resolve(name)
+        for bank in self.banks.values():
+            bank.A._set_data(bank.A._value().at[lane].set(0.0))
+            bank.B._set_data(bank.B._value().at[lane].set(0.0))
+        del self._registry[name]
+        self._free.append(lane)
+        self._free.sort()
+        return version
+
+    # -- per-slot staging (host, between steps) ----------------------------
+
+    def stage_slot(self, slot: int, name: Optional[str]) -> None:
+        """Write one slot's adapter lane id (admission and
+        preempt-resume both land here).  KeyError if ``name`` is no
+        longer loaded — the engine turns that into a machine-readable
+        request failure."""
+        lane = 0 if name is None else self.resolve(name)[0]
+        self.adapter_ids._set_data(
+            self.adapter_ids._value().at[slot].set(jnp.int32(lane)))
+
+    def reset_slots(self) -> None:
+        """Forget per-slot ids (warmup scribbles slot 0); loaded banks
+        survive — adapters loaded before warmup stay served."""
+        self.adapter_ids._set_data(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+
+    # -- traced row binding (inside the step closures) ---------------------
+
+    def set_rows(self, rows) -> None:
+        self._rows = rows
+
+    def clear_rows(self) -> None:
+        self._rows = None
+
+
+def make_lora_weights(pool: AdapterPool, seed: int = 0,
+                      init_scale: float = 0.02) -> Dict[str, tuple]:
+    """Random full-coverage adapter weights for ``pool`` (tests/bench):
+    both factors drawn ``N(0, init_scale)`` — deliberately NOT the
+    classic B=0 training init, so the adapter visibly changes outputs."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for key, bank in pool.banks.items():
+        out[key] = (
+            rng.normal(0.0, init_scale,
+                       (bank.in_features, pool.rank)).astype(np.float32),
+            rng.normal(0.0, init_scale,
+                       (pool.rank, bank.out_features)).astype(np.float32),
+        )
+    return out

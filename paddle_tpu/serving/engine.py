@@ -221,6 +221,15 @@ class Request:
     #: weight version the serving engine held when this request was
     #: admitted (bumped by rolling hot-swaps; 0 = initial weights)
     model_version: int = 0
+    #: tenant label for SLO accounting: the adapter name if the request
+    #: selects one, else ``"grammar:<name>"`` for grammar-only requests,
+    #: else ``"base"`` — threaded into metrics and the tracer
+    tenant: str = "base"
+    #: adapter version pinned at enqueue (None when no adapter): a
+    #: hot-swap or unload of that adapter fails this request rather than
+    #: serving a torn hybrid, and recovery refuses to replay onto any
+    #: other version
+    adapter_version: Optional[int] = None
     error: Optional[str] = None
     #: machine-readable context for backpressure/shed rejections
     #: (``{"depth": int, "retry_after_s": float}``)
@@ -367,6 +376,22 @@ class Engine:
             output stays bitwise identical to non-speculative decoding;
             seeded sampling stays distribution-preserving — see
             docs/SERVING.md "Speculative decoding".
+        adapters: an :class:`~.adapters.AdapterConfig` (or its kwargs as
+            a dict) opting this engine into multi-LoRA serving: stacked
+            per-target adapter lanes + a per-slot adapter-id lane, all
+            lifted compiled-step state (ZERO new cache keys), with
+            requests selecting a loaded adapter via
+            ``SamplingParams.adapter``.  None (default) attaches no
+            hooks — the model trace is byte-identical to pre-tenancy.
+            See docs/SERVING.md "Multi-tenant serving".
+        grammars: a dict mapping grammar name →
+            :class:`~.grammar.JsonArrayGrammar`-style spec (or a ready
+            :class:`~.grammar.GrammarTable`) opting this engine into
+            constrained decoding: requests select a grammar via
+            ``SamplingParams.grammar`` and the sampler masks illegal
+            tokens in-graph, composing with greedy/temperature/top-k/
+            top-p AND speculative verify.  None (default) = no grammar
+            lanes.
     """
 
     def __init__(self, model, *, num_slots: int = 4,
@@ -393,6 +418,8 @@ class Engine:
                  journal=None,
                  model_version: int = 0,
                  speculation=None,
+                 adapters=None,
+                 grammars=None,
                  mesh=None):
         cfg = getattr(model, "config", None)
         if cfg is None:
@@ -483,10 +510,35 @@ class Engine:
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}
         self.free_slots: List[int] = list(range(self.num_slots))
+        # constrained decoding (opt-in, docs/SERVING.md "Multi-tenant
+        # serving"): stacked per-grammar automaton tables the sampler
+        # masks logits with in-graph; None = no grammar lanes
+        self.grammar_table = None
+        if grammars is not None:
+            from .grammar import GrammarTable
+
+            self.grammar_table = (
+                grammars if isinstance(grammars, GrammarTable)
+                else GrammarTable(cfg.vocab_size, grammars))
         # on-device sampling state: per-slot params/key/token lanes,
         # lifted into the compiled steps like KV cache state — the token
         # lane IS the next decode step's input ids (no host round-trip)
-        self.sampler = DeviceSampler(self.num_slots)
+        self.sampler = DeviceSampler(self.num_slots,
+                                     grammar=self.grammar_table)
+        # multi-LoRA serving (opt-in, docs/SERVING.md "Multi-tenant
+        # serving"): stacked per-target adapter lanes + the per-slot
+        # adapter-id lane, hooked into every Column/Row parallel linear;
+        # None attaches no hooks (trace byte-identical to pre-tenancy)
+        self.adapter_pool = None
+        if adapters is not None:
+            from .adapters import AdapterConfig, AdapterPool
+
+            acfg = (adapters if isinstance(adapters, AdapterConfig)
+                    else AdapterConfig(**dict(adapters)))
+            self.adapter_pool = AdapterPool(
+                self.model, self.num_slots,
+                max_adapters=acfg.max_adapters, rank=acfg.rank,
+                dtype=cache_dtype)
         # speculative decoding (opt-in, docs/SERVING.md "Speculative
         # decoding"): the draft model + its KV pool + proposal lanes;
         # None keeps the plain one-token decode loop
@@ -601,6 +653,14 @@ class Engine:
         from .. import jit as jit_mod
 
         model, cache, sampler = self.model, self.cache, self.sampler
+        pool = self.adapter_pool
+
+        def _prefill_rows(slot):
+            # this prefill's slot selects its adapter lane: a [1] row id
+            # read from the lifted id lane (data, never a trace constant)
+            return jax.lax.dynamic_index_in_dim(
+                pool.adapter_ids._value(),
+                slot._value().astype(jnp.int32), axis=0, keepdims=True)
 
         if self.kv_layout == "paged":
             from .paging import PagedCacheContext
@@ -611,7 +671,13 @@ class Engine:
                 # real token is at tail index (length - start - 1)
                 ctx = PagedCacheContext(cache, "prefill", slot=slot,
                                         length=length, start=start)
-                logits = model(input_ids, cache_ctx=ctx)
+                if pool is not None:
+                    pool.set_rows(_prefill_rows(slot))
+                try:
+                    logits = model(input_ids, cache_ctx=ctx)
+                finally:
+                    if pool is not None:
+                        pool.clear_rows()
                 cache.set_length(slot, length)
                 arr = logits._value()                   # [1, S, V]
                 idx = (length._value() - start._value()).astype(
@@ -627,7 +693,13 @@ class Engine:
             def prefill_step(input_ids, slot, length):
                 ctx = CacheContext(cache, "prefill", slot=slot,
                                    length=length)
-                logits = model(input_ids, cache_ctx=ctx)
+                if pool is not None:
+                    pool.set_rows(_prefill_rows(slot))
+                try:
+                    logits = model(input_ids, cache_ctx=ctx)
+                finally:
+                    if pool is not None:
+                        pool.clear_rows()
                 cache.set_length(slot, length)
                 arr = logits._value()                   # [1, S, V]
                 last = jax.lax.dynamic_index_in_dim(
@@ -645,7 +717,14 @@ class Engine:
             # flash-decoding kernel instead of a materializing gather
             tokens = Tensor._wrap(sampler.tokens._value()[:, None])
             ctx = CacheContext(cache, "decode", active=active)
-            logits = model(tokens, cache_ctx=ctx)
+            if pool is not None:
+                # all slots decode at once: the full [slots] id lane
+                pool.set_rows(pool.adapter_ids._value())
+            try:
+                logits = model(tokens, cache_ctx=ctx)
+            finally:
+                if pool is not None:
+                    pool.clear_rows()
             cache.advance(active)
             toks = sampler.sample_all(
                 logits._value()[:, -1, :].astype(jnp.float32))
@@ -899,6 +978,29 @@ class Engine:
                         f"(bucket {self.bucket_for(req.prompt_ids.size)}, "
                         f"block_size {self.block_size}) but the pool "
                         f"holds {usable}")
+        s = req.sampling
+        if s.adapter is not None:
+            if self.adapter_pool is None:
+                return (f"sampling.adapter={s.adapter!r} but this engine "
+                        "has no adapter pool (Engine(adapters=...))")
+            try:
+                self.adapter_pool.resolve(s.adapter)
+            except KeyError as e:
+                return e.args[0]
+        if s.grammar is not None:
+            if self.grammar_table is None:
+                return (f"sampling.grammar={s.grammar!r} but this engine "
+                        "has no grammar table (Engine(grammars=...))")
+            try:
+                spec = self.grammar_table.spec_of(s.grammar)
+            except KeyError as e:
+                return e.args[0]
+            g_eos = getattr(spec, "eos_token_id", None)
+            if (g_eos is not None and req.eos_token_id is not None
+                    and req.eos_token_id != g_eos):
+                return (f"grammar {s.grammar!r} terminates on eos token "
+                        f"{g_eos} but the request sets "
+                        f"eos_token_id={req.eos_token_id}")
         return None
 
     def _reject(self, req: Request, reason: str) -> None:
@@ -954,6 +1056,20 @@ class Engine:
                                   else self.default_deadline_s),
                       priority=prio,
                       request_id=next(self._req_counter))
+        # tenant label for SLO accounting (adapter > grammar > base)
+        req.tenant = (sampling.adapter if sampling.adapter is not None
+                      else (f"grammar:{sampling.grammar}"
+                            if sampling.grammar is not None else "base"))
+        if (sampling.grammar is not None and req.eos_token_id is None
+                and self.grammar_table is not None):
+            # a grammar terminates on ITS eos token; default the
+            # request's stop condition to match (mismatch is rejected
+            # in _validate)
+            try:
+                spec = self.grammar_table.spec_of(sampling.grammar)
+                req.eos_token_id = getattr(spec, "eos_token_id", None)
+            except KeyError:
+                pass                     # unknown grammar → _validate
         req.t_enqueue = time.perf_counter()
         origin_wall = None
         jr = self.journal
@@ -977,6 +1093,12 @@ class Engine:
             err = ValueError(problem)
             err.request = req
             raise err
+        if sampling.adapter is not None:
+            # pin the adapter version at enqueue: unload/hot-swap of
+            # this name fails the request instead of serving a torn
+            # hybrid, and recovery refuses any other version
+            req.adapter_version = self.adapter_pool.resolve(
+                sampling.adapter)[1]
         wait = None if req.recovered else self._shed_wait_s(req)
         if wait is not None:
             depth = len(self.queue)
@@ -1019,19 +1141,26 @@ class Engine:
             # request its caller was told failed — reject the handle
             # and surface the storage error instead.
             s = req.sampling
+            samp = {"temperature": s.temperature, "top_k": s.top_k,
+                    "top_p": s.top_p, "seed": s.seed}
+            # tenancy keys ride only when set: pre-tenancy records (and
+            # base-tenant admissions) stay byte-identical
+            if s.adapter is not None:
+                samp["adapter"] = s.adapter
+            if s.grammar is not None:
+                samp["grammar"] = s.grammar
             try:
                 jr.record_admission(
                     req.journal_id, prompt_ids=req.prompt_ids,
-                    sampling={"temperature": s.temperature,
-                              "top_k": s.top_k,
-                              "top_p": s.top_p, "seed": s.seed},
+                    sampling=samp,
                     seed_effective=self._seed_for(req),
                     priority=req.priority, deadline_s=req.deadline_s,
                     max_new_tokens=req.max_new_tokens,
                     eos_token_id=req.eos_token_id, engine=self.name,
                     model_version=self.model_version,
                     recovered=req.recovered,
-                    mesh_shape=self.mesh_shape)
+                    mesh_shape=self.mesh_shape,
+                    adapter_version=req.adapter_version)
             except Exception as e:       # noqa: BLE001 — storage failure
                 req.journal_id = None    # nothing durable to audit
                 self._reject(req, f"journal admission write failed: "
@@ -1075,6 +1204,8 @@ class Engine:
             warm(use)
         self.cache.reset()
         self.sampler.reset()             # warmup scribbled slot 0's lanes
+        if self.adapter_pool is not None:
+            self.adapter_pool.reset_slots()
         if self.spec is not None:
             self.spec.reset()
         if self.shard is not None:
@@ -1227,7 +1358,8 @@ class Engine:
         if self.kv_layout == "paged" and self.prefix_cache is not None:
             try:
                 self.prefix_cache.register(victim.prompt_ids,
-                                           self.cache.owned_blocks(slot))
+                                           self.cache.owned_blocks(slot),
+                                           salt=self._tenant_salt(victim))
             except Exception:            # noqa: BLE001 — isolation boundary
                 self.metrics.on_prefix_register_error()
         self.running.pop(slot, None)
@@ -1322,6 +1454,21 @@ class Engine:
                 return False
         return True
 
+    def _tenant_salt(self, req: Request) -> bytes:
+        """Prefix-cache tenant salt for ``req``'s adapter (``b""`` for
+        the base tenant): folded into the chain-hash root so tenant KV
+        never cross-hits across adapters or versions.  An
+        unloaded-but-versioned name still salts uniquely, so a dying
+        tenant cannot poison anyone else's lookups."""
+        a = req.sampling.adapter
+        if a is None or self.adapter_pool is None:
+            return b""
+        try:
+            return self.adapter_pool.salt(a)
+        except KeyError:
+            v = self.adapter_pool.last_version(a)
+            return f"{a}@v{v}#unloaded".encode()
+
     def _prefix_lookup(self, req: Request):
         """Longest cached prefix of the prompt, ``(n_tokens, block_ids)``.
         A raising or over-budget lookup degrades to a miss: the request
@@ -1337,7 +1484,8 @@ class Engine:
         try:
             self._fault("serving.prefix_lookup")
             hit_tokens, blocks = self.prefix_cache.lookup(
-                req.prompt_ids, count=False)
+                req.prompt_ids, count=False,
+                salt=self._tenant_salt(req))
         except Exception:                # noqa: BLE001 — isolation boundary
             self.metrics.on_prefix_lookup_error()
             return 0, []
@@ -1407,7 +1555,8 @@ class Engine:
             # (hit blocks are refreshed, new full tail blocks registered)
             try:
                 self.prefix_cache.register(
-                    req.prompt_ids, self.cache.owned_blocks(req.slot))
+                    req.prompt_ids, self.cache.owned_blocks(req.slot),
+                    salt=self._tenant_salt(req))
             except Exception:            # noqa: BLE001 — isolation boundary
                 self.metrics.on_prefix_register_error()
         return "ok", last, bucket, P
@@ -1436,6 +1585,30 @@ class Engine:
         # first token on-device from exactly this state
         self.sampler.stage_slot(req.slot, req.sampling,
                                 self._seed_for(req))
+        if self.adapter_pool is not None:
+            # stage the slot's adapter lane id; a request whose adapter
+            # vanished (unload) or moved on (hot-swap bumped the
+            # version) between enqueue and admission fails here with
+            # machine-readable context instead of decoding under the
+            # wrong weights
+            a = req.sampling.adapter
+            try:
+                if a is not None and req.adapter_version is not None:
+                    _, v = self.adapter_pool.resolve(a)
+                    if v != req.adapter_version:
+                        raise KeyError(
+                            f"adapter {a!r} was hot-swapped to v{v} "
+                            f"(request pinned v{req.adapter_version})")
+                self.adapter_pool.stage_slot(req.slot, a)
+            except KeyError as e:
+                req.error_ctx = {
+                    "adapter": a,
+                    "version": (req.adapter_version
+                                if req.adapter_version is not None
+                                else self.adapter_pool.last_version(a)),
+                }
+                self._retire(req, "failed", error=str(e.args[0]))
+                return None
         if self.kv_layout == "paged":
             status, tok_t, bucket, prefix_hit = self._paged_prefill(req, L)
             if status == "deferred":
@@ -1506,7 +1679,7 @@ class Engine:
                                        {req.journal_id: tok})
         if not self._emit_token(req, tok, now):
             return
-        self.metrics.on_first_token(req.ttft_s)
+        self.metrics.on_first_token(req.ttft_s, tenant=req.tenant)
         if self._done_after_emit(req):
             self._retire(req)
 
@@ -1556,11 +1729,12 @@ class Engine:
             if self.spec is not None:
                 self.spec.release_slot(slot)
         if state == "finished":
-            self.metrics.on_complete()
+            self.metrics.on_complete(tenant=req.tenant,
+                                     n_tokens=len(req.output_ids))
         elif state == "cancelled":
             self.metrics.on_cancel()
         elif state == "failed":
-            self.metrics.on_fail()
+            self.metrics.on_fail(tenant=req.tenant)
         self.tracer.on_retired(req, self.name, state, req.error)
         if self.journal is not None and req.journal_id is not None:
             # fleet-owned requests end their ATTEMPT here; the router's
@@ -2076,15 +2250,24 @@ class Engine:
         self.metrics.queue_depth = 0
         return out
 
-    def prefix_probe(self, prompt_ids: Sequence[int]) -> int:
+    def prefix_probe(self, prompt_ids: Sequence[int],
+                     adapter: Optional[str] = None) -> int:
         """Longest prompt prefix (in tokens) this engine's prefix cache
         already holds — side-effect-free (no LRU refresh, no counters,
         no refs).  0 for the contiguous layout or a disabled/failing
-        cache; the fleet router's affinity signal."""
+        cache; the fleet router's affinity signal.  ``adapter`` probes
+        under that tenant's salt (cached KV is tenant-keyed; a base
+        probe can never see adapter blocks and vice versa)."""
         if self.prefix_cache is None:
             return 0
+        salt = b""
+        if adapter is not None and self.adapter_pool is not None:
+            try:
+                salt = self.adapter_pool.salt(adapter)
+            except KeyError:
+                return 0                 # unloaded → no cached KV here
         try:
-            return self.prefix_cache.probe(prompt_ids)
+            return self.prefix_cache.probe(prompt_ids, salt=salt)
         except Exception:                # noqa: BLE001 — advisory only
             return 0
 
@@ -2161,6 +2344,7 @@ class Engine:
                 journal.begin_attempt(jid, recovered=True,
                                       origin_wall=rec.get("wall"))
                 try:
+                    self._validate_replay_tenancy(rec, s)
                     r = self.add_request(
                         rec["prompt_ids"],
                         max_new_tokens=rec["max_new_tokens"],
@@ -2198,6 +2382,49 @@ class Engine:
                 "invalid": invalid,
                 "cross_mesh": sum(len(v) for v in cross.values()),
                 "outcomes": outcomes}
+
+    def _validate_replay_tenancy(self, rec: dict, s: dict) -> None:
+        """Bitwise-replay gate for a journaled tenant request: the
+        adapter must still be loaded AT THE JOURNALED VERSION (replaying
+        onto other weights would silently serve different tokens than
+        the crash-interrupted run promised) and the grammar must exist.
+        Raises ValueError — the caller's invalid-replay isolation path
+        fails THIS request finally and keeps replaying the rest."""
+        a = s.get("adapter")
+        if a is not None:
+            err_ctx = None
+            if self.adapter_pool is None:
+                err_ctx = {"adapter": a, "version": rec.get(
+                    "adapter_version")}
+                msg = (f"journaled adapter {a!r} but this engine has "
+                       "no adapter pool")
+            else:
+                try:
+                    _, v = self.adapter_pool.resolve(a)
+                except KeyError:
+                    v = None
+                want = rec.get("adapter_version")
+                if v is None:
+                    err_ctx = {"adapter": a, "version": want}
+                    msg = (f"journaled adapter {a!r} (v{want}) is not "
+                           "loaded on the recovering engine")
+                elif want is not None and v != want:
+                    err_ctx = {"adapter": a, "version": want}
+                    msg = (f"journaled adapter {a!r} v{want} != loaded "
+                           f"v{v}: bitwise replay impossible")
+            if err_ctx is not None:
+                e = ValueError(msg)
+                e.error_ctx = err_ctx
+                raise e
+        g = s.get("grammar")
+        if g is not None:
+            if self.grammar_table is None:
+                raise ValueError(f"journaled grammar {g!r} but this "
+                                 "engine has no grammar table")
+            try:
+                self.grammar_table.spec_of(g)
+            except KeyError as e:
+                raise ValueError(e.args[0]) from None
 
     def update_weights(self, state_or_path, *,
                        version: Optional[int] = None) -> int:
@@ -2251,6 +2478,82 @@ class Engine:
         if self.journal is not None:
             self.journal.record_weight_swap(self.name, self.model_version)
         return self.model_version
+
+    # -- multi-LoRA adapter lifecycle --------------------------------------
+
+    def _fail_adapter_inflight(self, name: str, why: str) -> int:
+        """Fail every queued and running request pinned to adapter
+        ``name`` with machine-readable ``error_ctx`` — the unload /
+        hot-swap contract: a lane about to be zeroed or overwritten in
+        place must never keep serving a request that pinned the old
+        version (that would be a torn hybrid).  Returns how many
+        requests were failed."""
+        v = self.adapter_pool.last_version(name)
+        failed = 0
+        hit = [q for q in list(self.queue)
+               if q.sampling.adapter == name]
+        for q in hit:
+            try:
+                self.queue.remove(q)
+            except ValueError:
+                continue                 # claimed by a concurrent path
+            q.error_ctx = {"adapter": name, "version": v}
+            self._retire(q, "failed",
+                         error=f"adapter {name!r} {why} while queued "
+                               f"(was v{v})")
+            failed += 1
+        for r in [r for r in list(self.running.values())
+                  if r.sampling.adapter == name]:
+            r.error_ctx = {"adapter": name, "version": v}
+            self._retire(r, "failed",
+                         error=f"adapter {name!r} {why} mid-flight "
+                               f"(was v{v})")
+            failed += 1
+        self.metrics.queue_depth = len(self.queue)
+        return failed
+
+    def load_adapter(self, name: str, weights, *,
+                     scale: float = 1.0) -> int:
+        """Load (or hot-swap) LoRA adapter ``name`` into a pool lane.
+        A hot swap (load over an already-loaded name) first FAILS that
+        adapter's in-flight requests — the lane is overwritten in place,
+        and a request that pinned the old version must not decode under
+        a torn mix of both.  Bumps the name's version (retiring its old
+        prefix-cache salt) and returns it."""
+        if self.adapter_pool is None:
+            raise RuntimeError(
+                f"engine {self.name!r} has no adapter pool "
+                "(construct with Engine(adapters=...))")
+        if name in self.adapter_pool.loaded:
+            self._fail_adapter_inflight(name, "hot-swapped")
+        _lane, version = self.adapter_pool.load(name, weights,
+                                                scale=scale)
+        if self.shard is not None:
+            # the _set_data writes landed host arrays — re-pin the lane
+            # tensors under their TP specs (same specs: no new keys)
+            self.shard.place_adapters(self.adapter_pool)
+        self.metrics.on_adapter_load(name, version)
+        self.tracer.on_adapter_load(self.name, name, version)
+        return version
+
+    def unload_adapter(self, name: str) -> int:
+        """Unload adapter ``name``: fail its in-flight requests (with
+        ``error_ctx = {"adapter", "version"}``), zero and free its lane.
+        The name's version counter survives for a later reload, so the
+        unloaded version's prefix-cache salt can never be minted again.
+        Returns the unloaded version."""
+        if self.adapter_pool is None:
+            raise RuntimeError(
+                f"engine {self.name!r} has no adapter pool "
+                "(construct with Engine(adapters=...))")
+        self.adapter_pool.resolve(name)  # KeyError if not loaded
+        self._fail_adapter_inflight(name, "unloaded")
+        version = self.adapter_pool.unload(name)
+        if self.shard is not None:
+            self.shard.place_adapters(self.adapter_pool)
+        self.metrics.on_adapter_unload(name, version)
+        self.tracer.on_adapter_unload(self.name, name, version)
+        return version
 
     def _stop_watchdog(self) -> None:
         """Join and drop the watchdog thread so a drained/stopped engine
@@ -2331,6 +2634,16 @@ class Engine:
         self.metrics._slots_busy = len(self.running)
         self.metrics.queue_depth = len(self.queue)
         snap = self.metrics.snapshot()
+        if self.adapter_pool is not None or self.grammar_table is not None:
+            snap["tenancy"] = {
+                "adapters": (self.adapter_pool.loaded
+                             if self.adapter_pool is not None else {}),
+                "adapter_lanes": (self.adapter_pool.max_adapters
+                                  if self.adapter_pool is not None
+                                  else 0),
+                "grammars": (list(self.grammar_table.names)
+                             if self.grammar_table is not None else []),
+            }
         if self.shard is not None:
             snap["sharding"] = {"mesh_shape": self.mesh_shape,
                                 "model_parallel": self.shard.mp}

@@ -1,0 +1,228 @@
+"""Constrained decoding: precompiled token-class mask tables driven by a
+per-slot automaton state lane advanced in-graph.
+
+The serving contract (docs/SERVING.md "Multi-tenant serving") is the same
+one every other per-request knob obeys: **which grammar a slot decodes
+under is data, never a trace constant**.  A :class:`GrammarTable` stacks
+every registered grammar's DFA into two device-resident tables —
+
+- ``trans [G, S_max, V] int32`` — next automaton state per
+  (grammar, state, token),
+- ``mask  [G, S_max, V] bool``  — token legality per (grammar, state),
+
+row 0 reserved for the **unconstrained** grammar (mask all-True, trans
+all-0), so unconstrained slots ride the exact same gathers.  The sampler
+carries two extra ``[slots] int32`` lanes (grammar id + automaton state,
+lifted into the compiled steps like the temperature lane) and applies
+``where(mask[g, s], logits, -1e30)`` before sampling; ``-1e30``
+underflows to exactly 0 probability under the f32 softmax AND loses every
+``argmax``/Gumbel comparison, so illegal tokens are unreachable under
+greedy and seeded sampling alike.  For grammar 0 the all-True mask row
+makes the ``where`` a bitwise identity — an engine built with a grammar
+table serves unconstrained requests bitwise identically to one without.
+
+State advance is one gather (``trans[g, s, tok]``) executed inside the
+compiled step right after sampling — no host round-trip, no shape change,
+zero new executable-cache keys (the lanes are lifted state, not
+arguments).
+
+Grammars are *finite* by design: the first (and currently only) grammar
+is :class:`JsonArrayGrammar`, a bounded-counter DFA over single-character
+tokens (token id == character code, matching the tiny configs'
+``vocab_size=128`` byte-level tokenizer) that accepts exactly the JSON
+arrays of at most ``max_elems`` non-negative integers of at most
+``max_digits`` digits (no leading zeros).  Bounding the counters keeps
+the automaton total: every non-terminal state has a legal continuation
+and the longest accepted string is ``1 + max_elems * (max_digits + 1)``
+characters, so any decode budget past that is guaranteed to terminate in
+the accepting state (where only EOS is legal).
+
+Deliberately NOT supported (see docs/SERVING.md): CFGs / recursive
+grammars (the state lane is a *finite* automaton — JSON objects of
+unbounded nesting need a pushdown store), multi-character tokenizers
+(masks are per-token-id; a BPE vocab needs token→charset compilation),
+and per-step host re-masking (everything lives in-graph).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["JsonArrayGrammar", "GrammarTable"]
+
+_NEG_INF = np.float32(-1e30)
+
+
+class JsonArrayGrammar:
+    """Bounded JSON-array-of-integers DFA over character-level tokens.
+
+    Accepts exactly ``[]`` and ``[n, n, ...]`` with 1..``max_elems``
+    elements, each a non-negative integer of 1..``max_digits`` digits
+    with no leading zeros (``"01"`` is not JSON), then requires EOS.
+    Token id == ASCII code (``[`` = 91, ``]`` = 93, ``,`` = 44,
+    ``0``-``9`` = 48..57), matching the byte-level tiny vocabs.
+
+    States (``n_states = 2 + max_elems * (max_digits + 1)``):
+
+    - 0 ``START``: only ``[`` is legal.
+    - 1 ``DONE`` (accepting): only ``eos_token_id`` is legal
+      (self-loop, so an engine that checks EOS one step late still
+      sits in a legal state).
+    - ``ELEM_OPEN(e)``: about to read element ``e``'s first digit;
+      ``]`` is also legal iff ``e == 0`` (empty array — and ONLY
+      there: no trailing commas).
+    - ``IN_NUM(e, d)``: read ``d`` digits of element ``e``; more
+      digits while ``d < max_digits``, ``,`` while another element
+      fits, ``]`` always.  ``0`` as a *first* digit jumps straight to
+      ``IN_NUM(e, max_digits)`` — the no-leading-zero rule.
+    """
+
+    def __init__(self, eos_token_id: int, *, max_elems: int = 3,
+                 max_digits: int = 2):
+        if max_elems < 1 or max_digits < 1:
+            raise ValueError("JsonArrayGrammar needs max_elems >= 1 and "
+                             "max_digits >= 1")
+        self.eos_token_id = int(eos_token_id)
+        self.max_elems = int(max_elems)
+        self.max_digits = int(max_digits)
+        self.n_states = 2 + self.max_elems * (self.max_digits + 1)
+        #: longest accepted token stream incl. EOS — a decode budget of
+        #: at least this many tokens can always reach DONE
+        self.max_tokens = 2 + self.max_elems * (self.max_digits + 1)
+
+    # state-id helpers (host-side; the tables are precomputed)
+    _START, _DONE = 0, 1
+
+    def _elem_open(self, e: int) -> int:
+        return 2 + e * (self.max_digits + 1)
+
+    def _in_num(self, e: int, d: int) -> int:
+        return 2 + e * (self.max_digits + 1) + d
+
+    def build(self, vocab_size: int):
+        """Materialize ``(trans [S, V] int32, mask [S, V] bool)``."""
+        V = int(vocab_size)
+        need = max(93, self.eos_token_id)        # ']' is the largest char
+        if V <= need:
+            raise ValueError(
+                f"JsonArrayGrammar needs vocab_size > {need} (character-"
+                f"level token ids + eos {self.eos_token_id}), got {V}")
+        LBRACK, RBRACK, COMMA = 91, 93, 44
+        digits = list(range(48, 58))
+        S = self.n_states
+        trans = np.zeros((S, V), dtype=np.int32)
+        mask = np.zeros((S, V), dtype=bool)
+
+        def edge(s: int, tok: int, nxt: int) -> None:
+            mask[s, tok] = True
+            trans[s, tok] = nxt
+
+        edge(self._START, LBRACK, self._elem_open(0))
+        edge(self._DONE, self.eos_token_id, self._DONE)
+        for e in range(self.max_elems):
+            opn = self._elem_open(e)
+            if e == 0:
+                edge(opn, RBRACK, self._DONE)
+            # first digit: '1'-'9' start a number; '0' IS the number
+            # (no leading zeros) — jump to the digits-exhausted state
+            edge(opn, digits[0], self._in_num(e, self.max_digits))
+            for dg in digits[1:]:
+                edge(opn, dg, self._in_num(e, 1))
+            for d in range(1, self.max_digits + 1):
+                s = self._in_num(e, d)
+                edge(s, RBRACK, self._DONE)
+                if e + 1 < self.max_elems:
+                    edge(s, COMMA, self._elem_open(e + 1))
+                if d < self.max_digits:
+                    for dg in digits:
+                        edge(s, dg, self._in_num(e, d + 1))
+        return trans, mask
+
+    def accepts(self, token_ids: Sequence[int], vocab_size: int) -> bool:
+        """Host-side oracle: walk the DFA over ``token_ids`` (EOS
+        included if emitted) and report whether every step was legal and
+        the walk ends accepting (DONE, or one legal EOS after DONE)."""
+        trans, mask = self.build(vocab_size)
+        s = self._START
+        for t in token_ids:
+            t = int(t)
+            if t >= vocab_size or not mask[s, t]:
+                return False
+            s = int(trans[s, t])
+        return s == self._DONE
+
+
+class GrammarTable:
+    """Stacked DFA tables for every registered grammar, as device lanes.
+
+    ``specs`` maps grammar *name* (the string requests carry in
+    ``SamplingParams.grammar``) to a grammar spec (currently
+    :class:`JsonArrayGrammar`).  Grammar ids are assigned 1..G in sorted
+    name order; id 0 is the reserved unconstrained grammar.  The stacked
+    ``trans``/``mask`` tensors are persistable — lifted into the compiled
+    steps as state, exactly like the sampler's parameter lanes — so
+    adding a grammar table changes ZERO executable-cache keys.
+
+    States past a grammar's ``n_states`` pad out with the unconstrained
+    row (all-True mask, trans 0); they are unreachable by construction
+    but must not produce an all-``-inf`` logits row if ever indexed.
+    """
+
+    def __init__(self, vocab_size: int, specs: Dict[str, object]):
+        if not specs:
+            raise ValueError("GrammarTable needs at least one grammar "
+                             "spec (or pass grammars=None to the engine)")
+        self.vocab_size = int(vocab_size)
+        self.names = {name: gid for gid, name
+                      in enumerate(sorted(specs), start=1)}
+        self.specs = dict(specs)
+        self.max_states = max(int(s.n_states) for s in specs.values())
+        G = len(specs) + 1
+        trans = np.zeros((G, self.max_states, self.vocab_size),
+                         dtype=np.int32)
+        mask = np.ones((G, self.max_states, self.vocab_size), dtype=bool)
+        for name, gid in self.names.items():
+            t, m = specs[name].build(self.vocab_size)
+            trans[gid, :t.shape[0]] = t
+            mask[gid, :m.shape[0]] = m
+        self.trans = Tensor._wrap(jnp.asarray(trans))
+        self.mask = Tensor._wrap(jnp.asarray(mask))
+        for t in (self.trans, self.mask):
+            t.persistable = True
+
+    # -- host side ---------------------------------------------------------
+
+    def gid_of(self, name: Optional[str]) -> int:
+        """Grammar id for a request: 0 (unconstrained) for None."""
+        if name is None:
+            return 0
+        try:
+            return self.names[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown grammar {name!r}; registered: "
+                f"{sorted(self.names)}") from None
+
+    def spec_of(self, name: str):
+        self.gid_of(name)                 # the KeyError with the listing
+        return self.specs[name]
+
+    # -- traced (inside the compiled steps) --------------------------------
+
+    def mask_rows(self, logits, gids, states):
+        """``where(mask[g, s], logits, -1e30)`` — broadcasts over any
+        leading shape pairing (``[V]`` row with scalar g/s, ``[S, V]``
+        batch with ``[S]`` lanes).  Grammar 0 rows are bitwise identity
+        (the select copies the original logits values through)."""
+        legal = self.mask._value()[gids, states]
+        return jnp.where(legal, logits, _NEG_INF)
+
+    def advance(self, gids, states, tokens):
+        """Next automaton state per row: ``trans[g, s, tok]`` (one
+        gather, in-graph)."""
+        return self.trans._value()[gids, states,
+                                   jnp.asarray(tokens, dtype=jnp.int32)]

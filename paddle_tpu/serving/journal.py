@@ -321,7 +321,8 @@ class RequestJournal:
                          eos_token_id: Optional[int], engine: str,
                          model_version: int,
                          recovered: bool = False,
-                         mesh_shape: Optional[str] = None) -> None:
+                         mesh_shape: Optional[str] = None,
+                         adapter_version: Optional[int] = None) -> None:
         """The replay recipe: everything a fresh process needs to
         re-admit this request bitwise (``seed_effective`` is the seed
         ``Engine._seed_for`` resolved at THIS admission, so an unseeded
@@ -330,21 +331,34 @@ class RequestJournal:
         ``mesh_shape`` is the sharded engine's mesh-shape key
         (``"model=2"``) — recorded only when set, so unsharded journals
         are byte-identical to pre-sharding ones, and recovery can refuse
-        to replay a sharded admission onto a different topology."""
+        to replay a sharded admission onto a different topology.
+        Tenancy rides the same only-when-set discipline: the sampling
+        dict's ``adapter``/``grammar`` keys and the top-level
+        ``adapter_version`` appear only for tenant requests, so
+        base-tenant records stay byte-identical to pre-tenancy ones —
+        and recovery replays a tenant request ONLY onto the exact
+        journaled adapter version (bitwise or not at all)."""
         s = dict(sampling)
         extra = {} if mesh_shape is None else {"mesh_shape": mesh_shape}
+        if adapter_version is not None:
+            extra["adapter_version"] = int(adapter_version)
+        samp = {
+            "temperature": float(s.get("temperature", 0.0)),
+            "top_k": int(s.get("top_k", 0)),
+            "top_p": float(s.get("top_p", 1.0)),
+            "seed": (None if s.get("seed") is None
+                     else int(s["seed"])),
+        }
+        if s.get("adapter") is not None:
+            samp["adapter"] = str(s["adapter"])
+        if s.get("grammar") is not None:
+            samp["grammar"] = str(s["grammar"])
         self._append({
             **extra,
             "kind": "admit", "jid": jid, "wall": round(time.time(), 6),
             "prompt_ids": [int(t) for t in prompt_ids],
             # plain-python coercion: numpy scalars are not JSON
-            "sampling": {
-                "temperature": float(s.get("temperature", 0.0)),
-                "top_k": int(s.get("top_k", 0)),
-                "top_p": float(s.get("top_p", 1.0)),
-                "seed": (None if s.get("seed") is None
-                         else int(s["seed"])),
-            },
+            "sampling": samp,
             "seed_effective": int(seed_effective),
             "priority": int(priority),
             "deadline_s": (None if deadline_s is None
@@ -459,6 +473,10 @@ class RequestJournal:
         s = dict(rec["sampling"])
         if s.get("seed") is None:
             s["seed"] = rec["seed_effective"]
+        # pre-tenancy records carry no adapter/grammar keys: backfill
+        # None so SamplingParams(**s) stays constructible forever
+        s.setdefault("adapter", None)
+        s.setdefault("grammar", None)
         return s
 
     def tokens_for(self, jid: str) -> list:

@@ -91,6 +91,13 @@ class ServingMetrics:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_cb = None
+        # multi-tenant serving (ISSUE 20): per-tenant SLO accounting —
+        # tenant label = adapter name / "grammar:<name>" / "base" — plus
+        # the adapter lifecycle counters.  Tenants appear on first
+        # traffic; a single-tenant engine exports {"base": ...} only.
+        self.tenants: Dict[str, dict] = {}
+        self.adapter_loads = 0
+        self.adapter_unloads = 0
         # engine-provided liveness snapshot (set by serving.Engine)
         self.health_cb = None
         # paged-KV observability (set by serving.Engine in paged mode):
@@ -133,8 +140,20 @@ class ServingMetrics:
             self.prefills_by_bucket.get(bucket, 0) + 1
         self.queue_depth = depth
 
-    def on_first_token(self, ttft_s: float) -> None:
+    def _tenant(self, tenant: str) -> dict:
+        t = self.tenants.get(tenant)
+        if t is None:
+            t = self.tenants[tenant] = {
+                "ttft_s": deque(maxlen=_LATENCY_WINDOW),
+                "completed": 0, "failed": 0, "tokens": 0,
+            }
+        return t
+
+    def on_first_token(self, ttft_s: float,
+                       tenant: Optional[str] = None) -> None:
         self.ttft_s.append(ttft_s)
+        if tenant is not None:
+            self._tenant(tenant)["ttft_s"].append(ttft_s)
 
     def on_decode_step(self, n_active: int, step_s: float) -> None:
         self.decode_steps += 1
@@ -167,11 +186,25 @@ class ServingMetrics:
                 self.decode_tokens += n
                 self.itl_s.extend([step_s / n] * n)
 
-    def on_complete(self) -> None:
+    def on_complete(self, tenant: Optional[str] = None,
+                    n_tokens: int = 0) -> None:
         self.requests_completed += 1
+        if tenant is not None:
+            t = self._tenant(tenant)
+            t["completed"] += 1
+            t["tokens"] += int(n_tokens)
 
-    def on_fail(self) -> None:
+    def on_fail(self, tenant: Optional[str] = None) -> None:
         self.requests_failed += 1
+        if tenant is not None:
+            self._tenant(tenant)["failed"] += 1
+
+    def on_adapter_load(self, name: str, version: int) -> None:
+        """A LoRA adapter was loaded (or hot-swapped) into a pool lane."""
+        self.adapter_loads += 1
+
+    def on_adapter_unload(self, name: str, version: int) -> None:
+        self.adapter_unloads += 1
 
     def on_cancel(self) -> None:
         self.requests_cancelled += 1
@@ -299,6 +332,25 @@ class ServingMetrics:
         })
         return out
 
+    def _tenants_section(self) -> dict:
+        """Per-tenant SLO gauges keyed by tenant label, plus the adapter
+        lifecycle counters — always present (empty ``by_tenant`` before
+        the first tenant-labelled request) so dashboards can bind to the
+        shape unconditionally."""
+        by_tenant = {}
+        for name in sorted(self.tenants):
+            t = self.tenants[name]
+            by_tenant[name] = {
+                "completed": t["completed"],
+                "failed": t["failed"],
+                "tokens": t["tokens"],
+                "ttft_ms": {k: round(v * 1e3, 3) if k != "count" else v
+                            for k, v in _dist(t["ttft_s"]).items()},
+            }
+        return {"adapter_loads": self.adapter_loads,
+                "adapter_unloads": self.adapter_unloads,
+                "by_tenant": by_tenant}
+
     def occupancy(self) -> float:
         """Mean busy-slot fraction over all samples so far (0.0 before
         the first step) — shared by ``snapshot()`` and the fleet
@@ -348,6 +400,7 @@ class ServingMetrics:
             },
             "paging": self._paging_section(),
             "speculation": self._speculation_section(),
+            "tenants": self._tenants_section(),
             "queue_depth": self.queue_depth,
             "queue_depth_max": self.queue_depth_max,
             "slot_occupancy": round(occ, 4),

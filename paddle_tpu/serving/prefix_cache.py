@@ -42,6 +42,13 @@ module):
   stronger guarantee than clearing (there is no window where a stale
   entry is still reachable).  The bump also drops every idle entry so
   the old-weight blocks return to the pool.
+- **Tenant salt.**  Multi-LoRA serving makes cached K/V a function of
+  the *adapter* that prefilled it too, so every lookup/register/probe
+  takes a ``salt`` (``b""`` for the base model, ``b"name@vN"`` from
+  :meth:`~.adapters.AdapterPool.salt` for a tenant) folded into the
+  chain-hash root alongside the epoch.  Tenant KV can never cross-hit
+  another tenant — or a stale version of itself — by the same
+  disjoint-domain argument as the epoch.
 """
 from __future__ import annotations
 
@@ -97,9 +104,10 @@ class PrefixCache:
 
     # -- lookup / register -------------------------------------------------
 
-    def _keys_for(self, prompt: np.ndarray, n_blocks: int) -> List[bytes]:
+    def _keys_for(self, prompt: np.ndarray, n_blocks: int,
+                  salt: bytes = b"") -> List[bytes]:
         bs, keys = self.block_size, []
-        parent = _ROOT + self.epoch.to_bytes(8, "little")
+        parent = _ROOT + self.epoch.to_bytes(8, "little") + salt
         for i in range(n_blocks):
             parent = _chain_hash(parent, prompt[i * bs:(i + 1) * bs])
             keys.append(parent)
@@ -116,8 +124,8 @@ class PrefixCache:
         self.hit_blocks_total += int(hit_tokens) // self.block_size
         self.hit_tokens_total += int(hit_tokens)
 
-    def lookup(self, prompt: Sequence[int], count: bool = True
-               ) -> Tuple[int, List[int]]:
+    def lookup(self, prompt: Sequence[int], count: bool = True,
+               salt: bytes = b"") -> Tuple[int, List[int]]:
         """Longest cached prefix of ``prompt``: ``(n_tokens, block_ids)``.
 
         Walks the hash chain over whole prompt blocks, stopping at the
@@ -133,7 +141,7 @@ class PrefixCache:
             self.lookup_tokens_total += int(prompt.size)
         max_hit = max(0, (int(prompt.size) - 1) // self.block_size)
         block_ids: List[int] = []
-        for key in self._keys_for(prompt, max_hit):
+        for key in self._keys_for(prompt, max_hit, salt):
             e = self._entries.get(key)
             if e is None:
                 break
@@ -145,7 +153,7 @@ class PrefixCache:
             self.hit_tokens_total += len(block_ids) * self.block_size
         return len(block_ids) * self.block_size, block_ids
 
-    def probe(self, prompt: Sequence[int]) -> int:
+    def probe(self, prompt: Sequence[int], salt: bytes = b"") -> int:
         """Side-effect-free longest-cached-prefix length in TOKENS: no
         LRU refresh, no hit/lookup counters, no references taken.  The
         fleet router's affinity probe — it may interrogate every
@@ -155,14 +163,14 @@ class PrefixCache:
         prompt = np.asarray(list(prompt), dtype=np.int64).reshape(-1)
         max_hit = max(0, (int(prompt.size) - 1) // self.block_size)
         n = 0
-        for key in self._keys_for(prompt, max_hit):
+        for key in self._keys_for(prompt, max_hit, salt):
             if key not in self._entries:
                 break
             n += 1
         return n * self.block_size
 
-    def register(self, prompt: Sequence[int], block_ids: Sequence[int]
-                 ) -> int:
+    def register(self, prompt: Sequence[int], block_ids: Sequence[int],
+                 salt: bytes = b"") -> int:
         """Make ``prompt``'s whole blocks hittable by later requests.
 
         ``block_ids`` must cover the prompt's full blocks in order (the
@@ -174,7 +182,7 @@ class PrefixCache:
         prompt = np.asarray(list(prompt), dtype=np.int64).reshape(-1)
         n_full = min(int(prompt.size) // self.block_size, len(block_ids))
         created, parent = 0, None
-        for depth, key in enumerate(self._keys_for(prompt, n_full)):
+        for depth, key in enumerate(self._keys_for(prompt, n_full, salt)):
             e = self._entries.get(key)
             if e is not None:
                 self._entries.move_to_end(key)

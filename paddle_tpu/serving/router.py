@@ -442,7 +442,16 @@ class Fleet:
         return [r for r in self.replicas
                 if r.state == "active" and r not in exclude]
 
-    def _choose_replica(self, prompt_ids, exclude: Sequence[_Replica] = ()
+    @staticmethod
+    def _adapter_of(freq: FleetRequest) -> Optional[str]:
+        """The adapter a fleet request selects (None for base) — probes
+        ride the tenant's prefix-cache salt, so affinity only credits
+        KV the request could actually hit."""
+        s = freq.kwargs.get("sampling")
+        return getattr(s, "adapter", None) if s is not None else None
+
+    def _choose_replica(self, prompt_ids, exclude: Sequence[_Replica] = (),
+                        adapter: Optional[str] = None
                         ) -> Tuple[Optional[_Replica], int]:
         """Dispatch policy: the replica whose prefix cache covers the
         longest prefix of the prompt (ties → least-loaded), else
@@ -451,7 +460,8 @@ class Fleet:
         cands = self._active(exclude)
         if not cands:
             return None, 0
-        probed = [(rep, rep.engine.prefix_probe(prompt_ids))
+        probed = [(rep, rep.engine.prefix_probe(prompt_ids,
+                                                adapter=adapter))
                   for rep in cands]
         best_hit = max(hit for _, hit in probed)
         if best_hit > 0:
@@ -523,8 +533,9 @@ class Fleet:
                         f"replica {pin} is {rep.state}: cannot pin")
                 affinity = 0
             else:
-                rep, affinity = self._choose_replica(freq.prompt_ids,
-                                                     excluded)
+                rep, affinity = self._choose_replica(
+                    freq.prompt_ids, excluded,
+                    adapter=self._adapter_of(freq))
                 if rep is None:
                     raise EngineStopped(
                         f"fleet {self.name!r} has no active replica "
@@ -548,7 +559,14 @@ class Fleet:
                     freq.prompt_ids, stream_cb=self._wrap_stream(freq),
                     **freq.kwargs)
             except ValueError as e:
-                # enqueue-time validation: deterministic, final
+                # enqueue-time validation: deterministic, final — with
+                # the engine handle's machine-readable context (e.g. an
+                # unknown/unloaded adapter's name + version) mirrored
+                # onto the fleet handle
+                ereq = getattr(e, "request", None)
+                if ereq is not None and \
+                        getattr(ereq, "error_ctx", None) is not None:
+                    freq.error_ctx = dict(ereq.error_ctx)
                 self._finish(freq, "rejected",
                              error=getattr(e.request, "error", str(e))
                              if hasattr(e, "request") else str(e))
@@ -765,6 +783,11 @@ class Fleet:
             if freq.done:                # late echo of a settled request
                 continue
             freq._attempt = None
+            if getattr(ereq, "error_ctx", None) is not None and \
+                    freq.error_ctx is None:
+                # machine-readable failure context (adapter unload /
+                # hot-swap mid-flight) survives onto the fleet handle
+                freq.error_ctx = dict(ereq.error_ctx)
             if ereq.state == "finished":
                 self._finish(freq, "finished")
             elif ereq.state == "cancelled":
@@ -1081,6 +1104,16 @@ class Fleet:
         freq._fleet = weakref.ref(self)
         self.metrics.on_submit()
         self.tracer.on_submitted(freq, self.name)
+        problem = self._replay_tenancy_problem(rec, s)
+        if problem is not None:
+            # a replay whose adapter was unloaded / hot-swapped (or
+            # whose grammar is gone) can never be bitwise — fail THIS
+            # request with machine-readable context and keep draining
+            # the rest of the pending set (never wedge the loop)
+            msg, ctx = problem
+            freq.error_ctx = ctx
+            self._finish(freq, "failed", error=msg)
+            return freq
         try:
             self._dispatch(freq)
         except (QueueFull, EngineStopped) as e:
@@ -1093,6 +1126,88 @@ class Fleet:
         except ValueError:
             pass                         # _dispatch already rejected it
         return freq
+
+    def _replay_tenancy_problem(self, rec: dict, s: dict):
+        """Can this journaled replay still run bitwise on the current
+        fleet?  Returns ``None`` when yes, else ``(message, error_ctx)``
+        — the adapter must be loaded at the EXACT journaled version on
+        some active replica (an unload or hot-swap in between means the
+        replay would run different weights), and the grammar must still
+        be registered."""
+        a = s.get("adapter")
+        if a is not None:
+            want = rec.get("adapter_version")
+            for rep in self._active():
+                pool = getattr(rep.engine, "adapter_pool", None)
+                if pool is None:
+                    continue
+                try:
+                    _, v = pool.resolve(a)
+                except KeyError:
+                    continue
+                if want is None or v == want:
+                    break
+            else:
+                return (f"recovery replay rejected: journaled adapter "
+                        f"{a!r} (v{want}) is not loaded at that version "
+                        f"on any active replica",
+                        {"adapter": a, "version": want})
+        g = s.get("grammar")
+        if g is not None:
+            for rep in self._active():
+                table = getattr(rep.engine, "grammar_table", None)
+                if table is not None and g in table.names:
+                    break
+            else:
+                return (f"recovery replay rejected: journaled grammar "
+                        f"{g!r} is not registered on any active "
+                        f"replica", {"grammar": g})
+        return None
+
+    def load_adapter(self, name: str, weights, *, scale: float = 1.0
+                     ) -> int:
+        """Load (or hot-swap) a LoRA adapter onto EVERY active replica's
+        engine so fleet dispatch stays placement-free — any replica can
+        serve any tenant.  Returns the adapter's registry version (all
+        replicas agree when loads only go through the fleet).  Replicas
+        rebuilt after a failure come back adapter-less: reload through
+        this method before routing that tenant's traffic again."""
+        if self.state != "active":
+            raise EngineStopped(
+                f"fleet {self.name!r} is {self.state}: cannot load "
+                "adapters")
+        version = None
+        for rep in self.replicas:
+            if rep.state not in ("active", "updating"):
+                continue
+            version = rep.engine.load_adapter(name, weights, scale=scale)
+        if version is None:
+            raise EngineStopped(
+                f"fleet {self.name!r} has no active replica to load "
+                f"adapter {name!r} onto")
+        return version
+
+    def unload_adapter(self, name: str) -> int:
+        """Unload an adapter from every active replica.  In-flight
+        requests of that tenant fail engine-side with machine-readable
+        ``error_ctx`` (surfaced onto their fleet handles by ``_reap``);
+        the registry remembers the name so version pins from journaled
+        admissions keep failing loudly rather than replaying onto
+        different weights."""
+        if self.state != "active":
+            raise EngineStopped(
+                f"fleet {self.name!r} is {self.state}: cannot unload "
+                "adapters")
+        version = None
+        for rep in self.replicas:
+            if rep.state not in ("active", "updating"):
+                continue
+            version = rep.engine.unload_adapter(name)
+        if version is None:
+            raise EngineStopped(
+                f"fleet {self.name!r} has no active replica to unload "
+                f"adapter {name!r} from")
+        return version
 
     def update_weights(self, state_or_path, *,
                        max_drain_steps: Optional[int] = None) -> dict:

@@ -53,12 +53,22 @@ class SamplingParams:
     sampling to the k highest-probability tokens; ``top_p < 1`` restricts
     it to the smallest nucleus of tokens whose cumulative probability
     reaches ``top_p`` (applied after top-k, on the tempered distribution).
+
+    Tenancy (docs/SERVING.md "Multi-tenant serving"): ``adapter`` names
+    a LoRA adapter loaded in the engine's :class:`~.adapters.AdapterPool`
+    (None = the base model); ``grammar`` names a registered constrained-
+    decoding grammar in its :class:`~.grammar.GrammarTable` (None =
+    unconstrained).  Both are *data* — per-slot lane values, never trace
+    constants — and both are journaled in the admit record so crash
+    recovery replays the same tenant bitwise.
     """
 
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
     seed: Optional[int] = None
+    adapter: Optional[str] = None
+    grammar: Optional[str] = None
 
     def __post_init__(self):
         if self.temperature < 0:
@@ -67,6 +77,11 @@ class SamplingParams:
             raise ValueError("top_k must be >= 0")
         if not (0.0 < self.top_p <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        for f in ("adapter", "grammar"):
+            v = getattr(self, f)
+            if v is not None and not isinstance(v, str):
+                raise ValueError(f"{f} must be a name (str) or None, "
+                                 f"got {type(v).__name__}")
 
 
 def _host_masked_logits(logits: np.ndarray,
@@ -180,10 +195,20 @@ class DeviceSampler:
     admission and on preempt-resume, which is what makes seeded replay
     bitwise deterministic (the old per-request ``RandomState`` contract,
     re-threaded through device key state).
+
+    Constrained decoding (``grammar`` — a :class:`~.grammar.GrammarTable`
+    or None): two more ``[slots] int32`` lanes carry each slot's grammar
+    id and automaton state (the state *before* the next token).  Logits
+    are grammar-masked BEFORE :func:`device_sample`, so the greedy branch
+    argmaxes the masked row and seeded sampling draws from the masked
+    law; the state lane advances in-graph right after sampling.  Grammar
+    id 0 (unconstrained) masks nothing bitwise, so a sampler built with
+    a table serves unconstrained slots identically to one without.
     """
 
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int, grammar=None):
         self.num_slots = int(num_slots)
+        self.grammar = grammar
         self.keys = Tensor._wrap(
             jnp.zeros((self.num_slots, 2), dtype=jnp.uint32))
         self.temps = Tensor._wrap(
@@ -194,8 +219,12 @@ class DeviceSampler:
             jnp.ones((self.num_slots,), dtype=jnp.float32))
         self.tokens = Tensor._wrap(
             jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        self.grammar_ids = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        self.grammar_states = Tensor._wrap(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
         for t in (self.keys, self.temps, self.top_ks, self.top_ps,
-                  self.tokens):
+                  self.tokens, self.grammar_ids, self.grammar_states):
             t.persistable = True
 
     # -- host-side staging (between steps; value-only, never a shape) ------
@@ -213,6 +242,15 @@ class DeviceSampler:
             jnp.int32(params.top_k)))
         self.top_ps._set_data(self.top_ps._value().at[slot].set(
             jnp.float32(params.top_p)))
+        if self.grammar is not None:
+            # grammar id + automaton start state: re-staged identically
+            # on preempt-resume/recovery, so a replayed request walks
+            # the same automaton path bitwise
+            gid = self.grammar.gid_of(params.grammar)
+            self.grammar_ids._set_data(
+                self.grammar_ids._value().at[slot].set(jnp.int32(gid)))
+            self.grammar_states._set_data(
+                self.grammar_states._value().at[slot].set(jnp.int32(0)))
 
     def reset(self) -> None:
         """Forget all slots (warmup scribbles over slot 0)."""
@@ -225,6 +263,10 @@ class DeviceSampler:
         self.top_ps._set_data(
             jnp.ones((self.num_slots,), dtype=jnp.float32))
         self.tokens._set_data(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        self.grammar_ids._set_data(
+            jnp.zeros((self.num_slots,), dtype=jnp.int32))
+        self.grammar_states._set_data(
             jnp.zeros((self.num_slots,), dtype=jnp.int32))
 
     # -- traced sampling (inside the compiled steps) -----------------------
@@ -242,12 +284,26 @@ class DeviceSampler:
         top_k = jax.lax.dynamic_index_in_dim(
             self.top_ks._value(), s, 0, keepdims=False)
         key = jax.lax.dynamic_index_in_dim(keys, s, 0, keepdims=False)
+        logits_row = logits_row.astype(jnp.float32)
+        if self.grammar is not None:
+            # grammar-mask BEFORE sampling (the greedy branch argmaxes
+            # its input, so masking here constrains greedy too); id 0
+            # rows select the original values through, bitwise
+            gid = jax.lax.dynamic_index_in_dim(
+                self.grammar_ids._value(), s, 0, keepdims=False)
+            gst = jax.lax.dynamic_index_in_dim(
+                self.grammar_states._value(), s, 0, keepdims=False)
+            logits_row = self.grammar.mask_rows(logits_row, gid, gst)
         tok, new_key = device_sample(
-            logits_row[None].astype(jnp.float32), row[0][None],
+            logits_row[None], row[0][None],
             top_k[None], row[1][None], key[None])
         self.keys._set_data(keys.at[s].set(new_key[0]))
         self.tokens._set_data(
             self.tokens._value().at[s].set(tok[0]))
+        if self.grammar is not None:
+            self.grammar_states._set_data(
+                self.grammar_states._value().at[s].set(
+                    self.grammar.advance(gid, gst, tok[0])))
         return tok[0]
 
     def sample_all(self, logits):
@@ -255,12 +311,20 @@ class DeviceSampler:
         advances every key lane and rewrites the token lane (idle slots
         sample garbage that is never delivered — their lanes re-seed at
         the next admission)."""
+        logits = logits.astype(jnp.float32)
+        if self.grammar is not None:
+            gids = self.grammar_ids._value()
+            gsts = self.grammar_states._value()
+            logits = self.grammar.mask_rows(logits, gids, gsts)
         toks, new_keys = device_sample(
-            logits.astype(jnp.float32), self.temps._value(),
+            logits, self.temps._value(),
             self.top_ks._value(), self.top_ps._value(),
             self.keys._value())
         self.keys._set_data(new_keys)
         self.tokens._set_data(toks)
+        if self.grammar is not None:
+            self.grammar_states._set_data(
+                self.grammar.advance(gids, gsts, toks))
         return toks
 
     def _masked_probs(self, logits):
@@ -269,7 +333,8 @@ class DeviceSampler:
         to every window position (softmax of the masked, tempered
         logits — exactly the distribution :func:`device_sample` draws
         from, so acceptance ratios price the real proposal/target
-        laws)."""
+        laws).  Grammar masking happens upstream, on the logits both
+        models' windows share — see :meth:`accept_speculative`."""
         S, W, V = logits.shape
         temps = jnp.repeat(jnp.where(self.temps._value() <= 0.0, 1.0,
                                      self.temps._value()), W)
@@ -326,6 +391,30 @@ class DeviceSampler:
         S, W, V = target_logits.shape
         k = W - 1
         greedy = self.temps._value() <= 0.0                   # [S]
+        target_logits = target_logits.astype(jnp.float32)
+        draft_logits = draft_logits.astype(jnp.float32)
+        if self.grammar is not None:
+            # Grammar masks apply IDENTICALLY to the draft and target
+            # laws at every window position — both renormalize on the
+            # same legal support, so the acceptance proof (min(1,
+            # pt/pd) accept + max(pt-pd, 0) residual, whose support is
+            # a subset of pt's) is preserved verbatim.  Window state j
+            # is the round-start lane state folded through the draft
+            # proposals — exactly the states the draft sampler held
+            # when it drew proposal j, so pd prices the law the
+            # proposals actually came from.
+            gids = self.grammar_ids._value()
+            g_start = self.grammar_states._value()
+            st = g_start
+            wmask = []
+            for j in range(W):
+                wmask.append(self.grammar.mask._value()[gids, st])
+                if j < k:
+                    st = self.grammar.advance(gids, st,
+                                              draft_tokens[:, j])
+            gmask = jnp.stack(wmask, axis=1)              # [S, W, V]
+            target_logits = jnp.where(gmask, target_logits, _NEG_INF)
+            draft_logits = jnp.where(gmask, draft_logits, _NEG_INF)
         pt = self._masked_probs(target_logits)                # [S, W, V]
         pd = draft_sampler._masked_probs(draft_logits)        # [S, W, V]
         # position k carries no proposal: zero its draft mass so the
@@ -372,4 +461,15 @@ class DeviceSampler:
         self.tokens._set_data(pend)
         # the draft chains off the same pending token next round
         draft_sampler.tokens._set_data(pend)
+        if self.grammar is not None:
+            # fold the automaton over the round's ACTUAL emissions
+            # (accepted prefix + replacement, truncated to m) and sync
+            # BOTH samplers' state lanes — next round's draft steps and
+            # verify window start from the same state, in lockstep
+            st = g_start
+            for j in range(W):
+                nxt = self.grammar.advance(gids, st, emitted[:, j])
+                st = jnp.where(j < m, nxt, st)
+            self.grammar_states._set_data(st)
+            draft_sampler.grammar_states._set_data(st)
         return emitted, m

@@ -218,20 +218,47 @@ class ServingShard:
             self._pin(bt)
 
     def place_sampler(self, sampler) -> None:
-        """All five sampling lanes replicate: one logical decision
-        stream drives all shards (the lanes are values, never shapes)."""
+        """All sampling lanes replicate: one logical decision stream
+        drives all shards (the lanes are values, never shapes).  The
+        tenancy lanes (grammar id/state) ride the same placement, as do
+        the grammar DFA tables — tiny, read-only, identical per shard."""
         for lane in (sampler.keys, sampler.temps, sampler.top_ks,
-                     sampler.top_ps, sampler.tokens):
+                     sampler.top_ps, sampler.tokens,
+                     sampler.grammar_ids, sampler.grammar_states):
             self._pin(lane)
+        if sampler.grammar is not None:
+            self._pin(sampler.grammar.trans)
+            self._pin(sampler.grammar.mask)
+
+    def place_adapters(self, pool) -> None:
+        """Adapter factors shard over the model axis exactly like the
+        weights they modify: a column target (out-dim sharded) shards
+        ``B``'s out dim, a row target (in-dim sharded) shards ``A``'s
+        in dim; the other factor and the slot id lane replicate.
+        Re-run after every ``load``/``unload`` — their ``_set_data``
+        writes land host arrays (same write-through contract as
+        ``update_weights``/``place_model``)."""
+        for bank in pool.banks.values():
+            if bank.kind == "column":
+                self._pin(bank.A)
+                self._pin(bank.B, P(None, None, MODEL_AXIS))
+            else:
+                self._pin(bank.A, P(None, MODEL_AXIS, None))
+                self._pin(bank.B)
+        self._pin(pool.adapter_ids)
 
     def place_state(self, engine) -> None:
         """(Re-)place every piece of lifted device state the compiled
         steps close over — the target cache and sampler plus, with
         speculation on, the draft model/cache/sampler and the proposals
-        lane.  Called at construction and again after ``warmup()``'s
-        reset (which replaces the arrays with fresh host zeros)."""
+        lane, and, with tenancy on, the adapter lanes.  Called at
+        construction and again after ``warmup()``'s reset (which
+        replaces the arrays with fresh host zeros)."""
         self.place_cache(engine.cache)
         self.place_sampler(engine.sampler)
+        pool = getattr(engine, "adapter_pool", None)
+        if pool is not None:
+            self.place_adapters(pool)
         spec = getattr(engine, "spec", None)
         if spec is not None:
             self.place_model(spec.model)

@@ -149,7 +149,12 @@ class SpecState:
             num_layers=dcfg.num_hidden_layers,
             max_seq=engine.max_seq + self.k,
             num_kv_heads=kv_heads, head_dim=dcfg.head_dim, dtype=dtype)
-        self.sampler = DeviceSampler(engine.num_slots)
+        # the draft sampler shares the ENGINE's grammar table (one
+        # stacked trans/mask pair serves both models), so draft
+        # proposals are drawn from the same masked support the verify
+        # step prices — see DeviceSampler.accept_speculative
+        self.sampler = DeviceSampler(engine.num_slots,
+                                     grammar=engine.sampler.grammar)
         self.proposals = Tensor._wrap(
             jnp.zeros((engine.num_slots, self.k), dtype=jnp.int32))
         self.proposals.persistable = True
@@ -198,6 +203,15 @@ class SpecState:
                 engine.sampler.tokens._value(), s, 0, keepdims=False)
             spec.sampler.tokens._set_data(
                 spec.sampler.tokens._value().at[s].set(tok))
+            if spec.sampler.grammar is not None:
+                # sync the automaton alongside the token it chains off:
+                # the target's prefill advanced past the first sampled
+                # token; the draft's first round starts from that state
+                gst = jax.lax.dynamic_index_in_dim(
+                    engine.sampler.grammar_states._value(), s, 0,
+                    keepdims=False)
+                spec.sampler.grammar_states._set_data(
+                    spec.sampler.grammar_states._value().at[s].set(gst))
             return Tensor._wrap(tok)
 
         return draft_prefill
@@ -243,7 +257,17 @@ class SpecState:
             t_in = Tensor._wrap(toks)
             tctx = CacheContext(engine.cache, "verify", active=active,
                                 width=W)
-            tlogits = engine.model(t_in, cache_ctx=tctx)
+            pool = engine.adapter_pool
+            if pool is not None:
+                # target verifies under each slot's adapter lane; the
+                # draft below runs un-adapted (acceptance prices the
+                # real draft law — see serving.adapters docstring)
+                pool.set_rows(pool.adapter_ids._value())
+            try:
+                tlogits = engine.model(t_in, cache_ctx=tctx)
+            finally:
+                if pool is not None:
+                    pool.clear_rows()
             # rewind the draft to the round-start offset (its k decode
             # steps advanced it) and recompute its window: draft KV for
             # all W positions + the exact proposal law for acceptance

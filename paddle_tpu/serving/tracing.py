@@ -245,7 +245,8 @@ class RequestTracer:
                     replica=replica, request_id=req.request_id,
                     prompt_len=int(req.prompt_ids.size),
                     priority=req.priority,
-                    preemptions=req.preemptions)
+                    preemptions=req.preemptions,
+                    tenant=getattr(req, "tenant", "base"))
 
     def on_shed(self, req, replica: str, wait_s: float) -> None:
         sid = self._attempt_span_for(req, replica)
@@ -262,7 +263,8 @@ class RequestTracer:
             sp["slot"] = slot
         self._event("admitted", trace=self._req_trace.get(req), span=sid,
                     replica=replica, request_id=req.request_id,
-                    bucket=bucket, slot=slot, prefix_hit=prefix_hit)
+                    bucket=bucket, slot=slot, prefix_hit=prefix_hit,
+                    tenant=getattr(req, "tenant", "base"))
 
     def on_decode_step(self, replica: str, step: int, slots,
                        dt_s: float) -> None:
@@ -355,6 +357,18 @@ class RequestTracer:
         """One replica finished its drain-and-swap: every admission on
         it from here serves model ``version``."""
         self._event("weight_swap", replica=replica, version=version)
+
+    def on_adapter_load(self, replica: str, adapter: str,
+                        version: int) -> None:
+        """A LoRA adapter was loaded (or hot-swapped) into this
+        replica's pool; admissions naming it serve ``version`` now."""
+        self._event("adapter_load", replica=replica, adapter=adapter,
+                    version=version)
+
+    def on_adapter_unload(self, replica: str, adapter: str,
+                          version: int) -> None:
+        self._event("adapter_unload", replica=replica, adapter=adapter,
+                    version=version)
 
     def on_weight_roll(self, fleet: str, version: int,
                        roll_s: float, replicas: int) -> None:

@@ -179,6 +179,19 @@ def test_serving_mode_emits_json_line():
     assert out["serving_degraded_mp"] == 1
     assert out["serving_degraded_replayed"] >= 1
     assert out["serving_degraded_lost"] == 0
+    # multi-tenant serving (ISSUE 20): one paged engine served a
+    # heterogeneous Poisson mix of base / two LoRA adapters / JSON-
+    # grammar tenants through the SAME warmed executables — bench fails
+    # structured on any steady-state compile miss, any cross-tenant
+    # prefix hit, or any invalid grammar output, so the pinned fields
+    # put per-class TTFT, the swap latency, and the validity rate on
+    # the one-JSON-line contract
+    assert out["serving_grammar_valid_rate"] == 1.0
+    assert out["serving_adapter_swap_ms"] > 0
+    for cls in ("base", "lora_a", "lora_b", "json"):
+        assert out[f"serving_tenant_{cls}_ttft_p50_ms"] > 0
+        assert out[f"serving_tenant_{cls}_ttft_p99_ms"] >= \
+            out[f"serving_tenant_{cls}_ttft_p50_ms"]
 
 
 def test_preflight_failure_is_structured():

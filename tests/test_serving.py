@@ -254,6 +254,11 @@ class TestEngineChurn:
 
         assert st["name"] in profiler.serving_stats()
 
+    @pytest.mark.slow  # demoted ISSUE 20: the GQA engine path is held
+    # in tier-1 by TIER1_CRITICAL siblings (paged_kernel + spec_decode
+    # GQA greedy-bitwise, sharded_serving GQA parity pairs) and the
+    # churn/zero-recompile law by test_gpt_zero_recompile_churn above —
+    # this pays a second full Llama warmup for no unique assertion
     def test_llama_gqa_engine_zero_recompile(self, llama):
         eng = Engine(llama, num_slots=2, max_seq=16, min_bucket=16)
         assert eng.buckets == [16]

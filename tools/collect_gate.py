@@ -170,6 +170,10 @@ TIER1_CRITICAL = {
     "tests/test_degraded_serving.py":
         "degraded-mode serving: cross-mesh journal replay bitwise "
         "both directions, viability ladder & shard-group failover",
+    "tests/test_tenancy.py":
+        "multi-tenant serving: adapter-lane bitwise-off proof, "
+        "per-tenant prefix isolation, grammar-masked decoding & "
+        "tenant crash-recovery",
 }
 
 

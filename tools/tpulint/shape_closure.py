@@ -75,6 +75,14 @@ CANONICAL = {
     # so the manifest must prove the degraded shape's key space is the
     # same closed set tier-1 warms.
     "serving_mesh_shapes": [2, 1],
+    # tenancy section (ISSUE 20): a paged engine with adapter lanes AND
+    # grammar lanes on.  Adapter ids / LoRA banks / grammar DFA tables
+    # enter the programs as LIFTED STATE (values, never shapes), so the
+    # section must enumerate the EXACT key set of the plain paged
+    # config — build_manifest asserts flatness and records the
+    # n_state_inputs drift per entry (the lanes are the drift).
+    "adapters": {"max_adapters": 2, "rank": 4},
+    "grammar": {"eos_token_id": 1, "max_elems": 3, "max_digits": 2},
 }
 
 
@@ -133,15 +141,18 @@ def _out_shapes(prog) -> List[List]:
 
 
 def _build_engine(kv_layout: str, cfg: dict, mesh=None):
-    from paddle_tpu.serving import Engine, SpecConfig
+    from paddle_tpu.serving import Engine, JsonArrayGrammar, SpecConfig
 
     kwargs = dict(num_slots=cfg["num_slots"], max_seq=cfg["max_seq"],
                   min_bucket=cfg["min_bucket"], mesh=mesh)
-    if kv_layout in ("paged", "speculative"):
+    if kv_layout in ("paged", "speculative", "tenancy"):
         kwargs.update(kv_layout="paged", block_size=cfg["block_size"])
     if kv_layout == "speculative":
         kwargs.update(speculation=SpecConfig(
             draft_model=cfg["spec_draft"], k=cfg["spec_k"]))
+    if kv_layout == "tenancy":
+        kwargs.update(adapters=dict(cfg["adapters"]),
+                      grammars={"json": JsonArrayGrammar(**cfg["grammar"])})
     eng = Engine(Engine.resolve_model(cfg["model"]), **kwargs)
     eng._build_steps()
     return eng
@@ -261,10 +272,14 @@ def enumerate_config(kv_layout: str, cfg: dict,
                    "max_seq": cfg["max_seq"],
                    "min_bucket": cfg["min_bucket"],
                    **({"block_size": cfg["block_size"]}
-                      if kv_layout in ("paged", "speculative") else {}),
+                      if kv_layout in ("paged", "speculative", "tenancy")
+                      else {}),
                    **({"spec_draft": cfg["spec_draft"],
                        "spec_k": cfg["spec_k"]}
-                      if kv_layout == "speculative" else {})},
+                      if kv_layout == "speculative" else {}),
+                   **({"adapters": dict(cfg["adapters"]),
+                       "grammar": dict(cfg["grammar"])}
+                      if kv_layout == "tenancy" else {})},
         "buckets": list(eng.buckets),
         "programs": len(entries),
         "entries": entries,
@@ -342,7 +357,7 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
     """Enumerate + probe both KV layouts; raises on any closure escape
     (an open key space must never be written as a 'proof')."""
     configs = {}
-    for layout in ("contiguous", "paged", "speculative"):
+    for layout in ("contiguous", "paged", "speculative", "tenancy"):
         section, (eng, key_index) = enumerate_config(layout, cfg)
         escapes = probe_closure(eng, key_index)
         if escapes:
@@ -352,7 +367,7 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
         section["closure_probe"] = {
             "prefill_instances": 2 * sum(
                 len(range(0, L, eng.block_size))
-                if layout in ("paged", "speculative") else 1
+                if layout in ("paged", "speculative", "tenancy") else 1
                 for L in range(1, eng.max_seq + 1)),
             "decode_instances": (
                 eng.num_slots + 1 if eng.spec is None
@@ -362,6 +377,28 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
             "escapes": 0,
         }
         configs[layout] = section
+    # tenancy flatness (ISSUE 20): adapter + grammar lanes must add
+    # ZERO cache keys — the tenancy section's key set is byte-identical
+    # to plain paged (lanes are lifted state: values, never shapes).
+    # What DOES grow is each program's lifted-state input count (the id
+    # lane, per-target LoRA A/B banks, grammar tables + per-slot
+    # grammar id/state lanes); the drift is recorded per entry so a
+    # silent future change (a lane becoming an argument, a bank
+    # splitting per slot) diffs loudly instead of passing as noise.
+    paged_keys = {n: e["key_sha256"]
+                  for n, e in configs["paged"]["entries"].items()}
+    ten_keys = {n: e["key_sha256"]
+                for n, e in configs["tenancy"]["entries"].items()}
+    if ten_keys != paged_keys:
+        raise AssertionError(
+            "tenancy: compiled-key set differs from plain paged — "
+            "adapter/grammar lanes must never widen the key space "
+            f"(paged {sorted(paged_keys)} vs tenancy {sorted(ten_keys)})")
+    configs["tenancy"]["keys_equal_paged"] = True
+    configs["tenancy"]["state_input_drift"] = {
+        name: e["n_state_inputs"]
+        - configs["paged"]["entries"][name]["n_state_inputs"]
+        for name, e in configs["tenancy"]["entries"].items()}
     # sharded sections (ISSUE 18): re-enumerate the plain layouts under
     # each canonical serving mesh shape.  The cache key excludes
     # sharding, so every section must be the SAME closed key set — any
@@ -392,7 +429,7 @@ def build_manifest(cfg: dict = CANONICAL) -> dict:
     # engine opt-in, not a fleet default): the multiplication note
     # covers contiguous + paged only
     per_replica = {k: v["programs"] for k, v in configs.items()
-                   if k != "speculative"}
+                   if k in ("contiguous", "paged")}
     manifest = {
         "_comment": [
             "Shape-closure proof for the serving engine's executable",
